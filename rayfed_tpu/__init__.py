@@ -1,0 +1,30 @@
+"""rayfed_tpu: a TPU-native multi-party federated execution framework.
+
+Same capability surface as ray-project/rayfed (reference
+``fed/__init__.py:15-30``): ``init``, ``remote``, ``get``, ``kill``,
+``shutdown``, ``send``, ``recv``, ``FedObject``, ``FedRemoteError`` — on a
+brand-new substrate: party-local JAX execution over device meshes, a native
+TCP/TLS data plane with a zero-pickle array fast path, and federated
+aggregation that lowers to XLA collectives (see SURVEY.md §7).
+"""
+
+from rayfed_tpu import tree_util  # noqa: F401  (must precede api import)
+from rayfed_tpu.api import get, init, kill, remote, shutdown  # noqa: F401
+from rayfed_tpu.exceptions import FedRemoteError  # noqa: F401
+from rayfed_tpu.fed_object import FedObject  # noqa: F401
+from rayfed_tpu.proxy.barriers import recv, send  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "remote",
+    "get",
+    "kill",
+    "shutdown",
+    "send",
+    "recv",
+    "FedObject",
+    "FedRemoteError",
+    "__version__",
+]

@@ -1,0 +1,147 @@
+"""Asynchronous send-drain + failure-propagation manager.
+
+Capability parity: reference ``fed/cleanup.py:29-203``. Cross-party pushes
+are fire-and-forget at the call site; their completion futures are drained
+here by daemon threads. A failed data send substitutes a
+:class:`FedRemoteError` envelope *under the same (upstream, downstream) seq
+ids* the peer is already waiting on (ref ``cleanup.py:160-172``) so the peer
+fails fast instead of hanging, then optionally SIGINTs this process
+(``exit_on_sending_failure``, ref ``cleanup.py:112-128,176-183``).
+
+Differences from the reference: the drained handle is a
+``concurrent.futures.Future`` from our sender proxy (no Ray ObjectRefs), and
+a producer-task failure is distinguished from a transport failure by the
+:class:`FedLocalError` wrapper instead of ``ray.exceptions.RayError``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Callable, Optional
+
+from rayfed_tpu._private.message_queue import MessageQueueManager
+from rayfed_tpu.exceptions import FedLocalError, FedRemoteError
+
+logger = logging.getLogger(__name__)
+
+
+class CleanupManager:
+    def __init__(self, current_party: str, acquire_shutdown_flag: Callable[[], bool]):
+        self._sending_data_q = MessageQueueManager(
+            self._process_data_send, thread_name="fedtpu-data-send-drain"
+        )
+        self._sending_error_q = MessageQueueManager(
+            self._process_error_send, thread_name="fedtpu-error-send-drain"
+        )
+        self._current_party = current_party
+        self._acquire_shutdown_flag = acquire_shutdown_flag
+        self._last_sending_error: Optional[Exception] = None
+        self._exit_on_sending_failure = False
+        self._expose_error_trace = False
+        # Fast-fail drain (entered by stop(wait_for_sending=False)): pending
+        # sends get a short bounded wait instead of blocking forever, and
+        # ones that cannot complete are substituted by error envelopes so
+        # peers parked on their rendezvous keys unblock instead of hanging
+        # (liveness the reference lacks: its non-graceful stop just drops
+        # queued sends, ref message_queue.py:84-99).
+        self._fast_fail = False
+
+    def start(self, exit_on_sending_failure: bool = False,
+              expose_error_trace: bool = False) -> None:
+        self._exit_on_sending_failure = exit_on_sending_failure
+        self._expose_error_trace = expose_error_trace
+        self._sending_data_q.start()
+        self._sending_error_q.start()
+
+    def stop(self, wait_for_sending: bool = False) -> None:
+        if not wait_for_sending:
+            self._fast_fail = True
+        # Data queue first: its failure handling may enqueue error sends
+        # (same ordering constraint as ref cleanup.py:71-76). Both queues
+        # always drain gracefully — in fast-fail mode each item's wait is
+        # bounded, so "graceful" stays prompt while guaranteeing that every
+        # queued edge either completes or gets an error envelope.
+        self._sending_data_q.stop(graceful=True)
+        self._sending_error_q.stop(graceful=True)
+
+    def push_to_sending(
+        self,
+        send_future,
+        dest_party: Optional[str] = None,
+        upstream_seq_id: int = -1,
+        downstream_seq_id: int = -1,
+        is_error: bool = False,
+    ) -> None:
+        """Track a pending cross-party send. ``send_future`` resolves when the
+        peer acknowledged the payload (or raises)."""
+        msg = (send_future, dest_party, upstream_seq_id, downstream_seq_id)
+        if is_error:
+            self._sending_error_q.append(msg)
+        else:
+            self._sending_data_q.append(msg)
+
+    def get_last_sending_error(self) -> Optional[Exception]:
+        return self._last_sending_error
+
+    def _signal_exit(self) -> None:
+        """SIGINT ourselves so the main thread runs the unintended-shutdown
+        path. The shutdown flag must be won *before* signalling to avoid the
+        signal-handler deadlock documented at ref cleanup.py:117-128."""
+        if self._acquire_shutdown_flag():
+            logger.warning("Signaling SIGINT to exit on sending failure.")
+            os.kill(os.getpid(), signal.SIGINT)
+
+    def _process_data_send(self, message) -> bool:
+        send_future, dest_party, upstream_seq_id, downstream_seq_id = message
+        try:
+            timeout = 2.0 if self._fast_fail else None
+            res = send_future.result(timeout=timeout)
+        except Exception as e:  # noqa: BLE001 - every failure must be handled
+            logger.warning(
+                "Failed to send to %s (upstream_seq_id=%s downstream_seq_id=%s): %s",
+                dest_party, upstream_seq_id, downstream_seq_id, e,
+            )
+            self._last_sending_error = e
+            if isinstance(e, FedLocalError) or self._fast_fail:
+                # Producer task raised (or we are tearing down and cannot
+                # wait): substitute an error envelope under the same seq ids
+                # the peer's recv is parked on so it unblocks.
+                from rayfed_tpu.proxy.barriers import send
+
+                error_trace = None
+                if self._expose_error_trace and isinstance(e, FedLocalError):
+                    error_trace = e.cause
+                send(
+                    dest_party,
+                    FedRemoteError(self._current_party, error_trace),
+                    upstream_seq_id,
+                    downstream_seq_id,
+                    is_error=True,
+                )
+            res = False
+
+        if not res and self._exit_on_sending_failure and not self._fast_fail:
+            self._signal_exit()
+            return False  # stop this drain thread; main thread cleans up
+        # In fast-fail teardown keep draining so every queued edge gets its
+        # envelope before the process exits.
+        return True
+
+    def _process_error_send(self, message) -> bool:
+        send_future, dest_party, upstream_seq_id, downstream_seq_id = message
+        try:
+            # Bounded even in normal mode: an unreachable peer must not
+            # wedge shutdown behind the full transport retry budget.
+            res = send_future.result(timeout=10.0 if self._fast_fail else 120.0)
+        except Exception:  # noqa: BLE001
+            res = False
+        if not res:
+            logger.warning(
+                "Failed to send error to %s (upstream_seq_id=%s "
+                "downstream_seq_id=%s); the peer may not sense this error.",
+                dest_party, upstream_seq_id, downstream_seq_id,
+            )
+        return True  # keep draining remaining error sends (ref cleanup.py:202)

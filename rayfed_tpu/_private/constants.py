@@ -1,0 +1,34 @@
+"""Internal constants.
+
+Mirrors the role of the reference's ``fed/_private/constants.py`` (key names
+for the job-scoped KV, logging format) with our own naming.
+"""
+
+KEY_OF_CLUSTER_CONFIG = "CLUSTER_CONFIG"
+KEY_OF_JOB_CONFIG = "JOB_CONFIG"
+
+KEY_OF_CLUSTER_ADDRESSES = "CLUSTER_ADDRESSES"
+KEY_OF_CURRENT_PARTY_NAME = "CURRENT_PARTY_NAME"
+KEY_OF_TLS_CONFIG = "TLS_CONFIG"
+KEY_OF_CROSS_SILO_COMM_CONFIG_DICT = "CROSS_SILO_COMM_CONFIG_DICT"
+
+KV_NAMESPACE_PREFIX = "FEDTPU"
+
+# Logging format: party and job name injected via logging.Filter, matching
+# the observability surface of the reference (``fed/_private/constants.py:30``).
+LOG_FORMAT = (
+    "%(asctime)s %(levelname)s %(filename)s:%(lineno)s"
+    " [%(party)s] -- [%(jobname)s] %(message)s"
+)
+
+DEFAULT_JOB_NAME = "default"
+
+# Wire protocol (see rayfed_tpu/proxy/tcp/wire.py).
+WIRE_MAGIC = b"FTP1"
+WIRE_VERSION = 1
+
+# Response codes on the data plane — kept numerically compatible with the
+# reference's HTTP-flavored codes (``fed/proxy/grpc/grpc_proxy.py:311-320``).
+CODE_OK = 200
+CODE_JOB_MISMATCH = 417
+CODE_INTERNAL_ERROR = 500

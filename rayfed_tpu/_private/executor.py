@@ -1,0 +1,174 @@
+"""Party-local task execution engine.
+
+This replaces the reference's L0 substrate — Ray tasks and actors
+(`ray.remote(...).remote()` submission at ref ``fed/api.py:413-417`` and the
+actor machinery at ``fed/_private/fed_actor.py``) — with an in-process
+dataflow thread pool. Rationale (TPU-first): party-local "tasks" are mostly
+jit-compiled JAX calls; XLA dispatch is already asynchronous and releases the
+GIL during device execution, so threads give real overlap without Ray's
+per-task IPC + serialization overhead (the reference's dominant cost in the
+many-tiny-tasks benchmark, ``benchmarks/many_tiny_tasks_benchmark.py``).
+
+Dataflow contract:
+ - ``submit`` returns one (or ``num_returns``) ``concurrent.futures.Future``.
+ - Arguments may contain Futures nested in pytrees; the worker resolves them
+   before invoking the function — mirroring Ray's ObjectRef dereferencing as
+   used via ``resolve_dependencies`` (ref ``fed/utils.py:48-83``).
+ - Because every dependency Future is created before any task that consumes
+   it, and the pool queue is FIFO, blocking waits inside workers cannot
+   deadlock: a blocked task's dependency has always already been dequeued.
+ - ``SerialLane`` provides actor semantics: one dedicated thread, methods
+   execute one-at-a-time in submission order (Ray actor ordering guarantee).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from queue import Queue
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+from rayfed_tpu import tree_util
+
+
+def _resolve(obj: Any) -> Any:
+    """Replace every Future leaf in a pytree with its result (blocking)."""
+    def leaf(x: Any) -> Any:
+        if isinstance(x, Future):
+            return x.result()
+        return x
+
+    return tree_util.tree_map(leaf, obj)
+
+
+def _run_task(
+    fn: Callable,
+    args: Sequence[Any],
+    kwargs: Optional[dict],
+    out: Union[Future, List[Future]],
+    num_returns: int,
+) -> None:
+    try:
+        rargs = _resolve(list(args))
+        rkwargs = _resolve(kwargs or {})
+        result = fn(*rargs, **rkwargs)
+    except BaseException as e:  # noqa: BLE001 - stored, not swallowed
+        if num_returns == 1:
+            out.set_exception(e)
+        else:
+            for f in out:
+                f.set_exception(e)
+        return
+    if num_returns == 1:
+        out.set_result(result)
+    else:
+        try:
+            items = list(result)
+            if len(items) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(items)} values"
+                )
+        except BaseException as e:  # noqa: BLE001
+            for f in out:
+                f.set_exception(e)
+            return
+        for f, item in zip(out, items):
+            f.set_result(item)
+
+
+class SerialLane:
+    """A single-threaded execution lane preserving submission order —
+    the actor execution model (ref ``fed/_private/fed_actor.py``)."""
+
+    def __init__(self, name: str = "fedtpu-actor-lane"):
+        self._q: "Queue[Optional[Callable[[], None]]]" = Queue()
+        self._lock = threading.Lock()
+        self.killed = False
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            item()
+
+    def submit_thunk(self, thunk: Callable[[], None]) -> bool:
+        """Enqueue; False if the lane was killed (caller must fail the
+        task's futures itself — nothing will ever dequeue them)."""
+        with self._lock:
+            if self.killed:
+                return False
+            self._q.put(thunk)
+            return True
+
+    def kill(self) -> None:
+        """Fail-fast teardown: queued-but-unexecuted thunks observe
+        ``killed`` and fail their futures instead of silently vanishing."""
+        with self._lock:
+            self.killed = True
+            self._q.put(None)
+
+    def stop(self) -> None:
+        self._q.put(None)
+
+
+class LocalExecutor:
+    """The party-local scheduler: a FIFO thread pool plus serial lanes."""
+
+    def __init__(self, max_workers: int = 32):
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="fedtpu-exec"
+        )
+        self._lanes: List[SerialLane] = []
+        self._lock = threading.Lock()
+
+    def submit(
+        self,
+        fn: Callable,
+        args: Sequence[Any] = (),
+        kwargs: Optional[dict] = None,
+        *,
+        num_returns: int = 1,
+        lane: Optional[SerialLane] = None,
+    ) -> Union[Future, List[Future]]:
+        if num_returns == 1:
+            out: Union[Future, List[Future]] = Future()
+        else:
+            out = [Future() for _ in range(num_returns)]
+
+        def fail_all(exc: BaseException) -> None:
+            for f in out if isinstance(out, list) else [out]:
+                f.set_exception(exc)
+
+        if lane is not None:
+            from rayfed_tpu.exceptions import FedActorKilledError
+
+            def thunk() -> None:
+                if lane.killed:
+                    fail_all(FedActorKilledError("actor was killed"))
+                    return
+                _run_task(fn, args, kwargs, out, num_returns)
+
+            if not lane.submit_thunk(thunk):
+                fail_all(FedActorKilledError("actor was killed"))
+        else:
+            self._pool.submit(
+                lambda: _run_task(fn, args, kwargs, out, num_returns)
+            )
+        return out
+
+    def new_lane(self, name: str = "fedtpu-actor-lane") -> SerialLane:
+        lane = SerialLane(name)
+        with self._lock:
+            self._lanes.append(lane)
+        return lane
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            lanes, self._lanes = self._lanes, []
+        for lane in lanes:
+            lane.stop()
+        self._pool.shutdown(wait=wait)

@@ -1,0 +1,142 @@
+"""Per-process global context: job identity, deterministic sequence ids,
+shutdown-once flag, and the cleanup (send-drain) manager.
+
+Capability parity: reference ``fed/_private/global_context.py:22-120``.
+The monotonically increasing ``next_seq_id`` is THE cross-party ordering
+mechanism — every party runs the same driver program, so every party numbers
+every call site identically (reference ``fed_call_holder.py:67``); the pair
+(producer seq id, consumer seq id) addresses each data-flow edge on the wire.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class GlobalContext:
+    def __init__(
+        self,
+        job_name: str,
+        current_party: str,
+        sending_failure_handler: Optional[Callable[[Exception], None]] = None,
+        exit_on_sending_failure: bool = False,
+        continue_waiting_for_data_sending_on_error: bool = False,
+    ) -> None:
+        self._job_name = job_name
+        self._current_party = current_party
+        self._seq_count = 0
+        self._seq_lock = threading.Lock()
+        self._sending_failure_handler = sending_failure_handler
+        self._exit_on_sending_failure = exit_on_sending_failure
+        self._continue_waiting_for_data_sending_on_error = (
+            continue_waiting_for_data_sending_on_error
+        )
+        self._atomic_shutdown_flag_lock = threading.Lock()
+        self._atomic_shutdown_flag = True
+        # The last *sending* error lives on the CleanupManager (the drain
+        # thread records it); only received errors are tracked here.
+        self._last_received_error: Optional[Exception] = None
+
+        # Imported lazily to avoid a cycle (cleanup → barriers → context).
+        from rayfed_tpu._private.cleanup import CleanupManager
+        from rayfed_tpu._private.executor import LocalExecutor
+
+        self._cleanup_manager = CleanupManager(
+            current_party, self.acquire_shutdown_flag
+        )
+        # The party-local task engine (replaces Ray task submission,
+        # ref fed/api.py:413-417).
+        self._executor = LocalExecutor()
+
+    # -- identity ---------------------------------------------------------
+    def get_job_name(self) -> str:
+        return self._job_name
+
+    def get_current_party(self) -> str:
+        return self._current_party
+
+    # -- deterministic DAG numbering (ref global_context.py:45-47) --------
+    def next_seq_id(self) -> int:
+        with self._seq_lock:
+            self._seq_count += 1
+            return self._seq_count
+
+    # -- cleanup / failure bookkeeping ------------------------------------
+    def get_cleanup_manager(self):
+        return self._cleanup_manager
+
+    def get_executor(self):
+        return self._executor
+
+    def get_sending_failure_handler(self):
+        return self._sending_failure_handler
+
+    def get_exit_on_sending_failure(self) -> bool:
+        return self._exit_on_sending_failure
+
+    def get_continue_waiting_for_data_sending_on_error(self) -> bool:
+        return self._continue_waiting_for_data_sending_on_error
+
+    def set_last_received_error(self, err: Exception) -> None:
+        self._last_received_error = err
+
+    def get_last_received_error(self) -> Optional[Exception]:
+        return self._last_received_error
+
+    def acquire_shutdown_flag(self) -> bool:
+        """Return True exactly once — the caller that wins performs shutdown.
+
+        Reference ``global_context.py:70-87``: uses a non-blocking acquire so
+        a signal handler re-entering during shutdown cannot deadlock.
+        """
+        if not self._atomic_shutdown_flag_lock.acquire(blocking=False):
+            return False
+        try:
+            if not self._atomic_shutdown_flag:
+                return False
+            self._atomic_shutdown_flag = False
+            return True
+        finally:
+            self._atomic_shutdown_flag_lock.release()
+
+
+_global_context: Optional[GlobalContext] = None
+_context_lock = threading.Lock()
+
+
+def init_global_context(
+    job_name: str,
+    current_party: str,
+    sending_failure_handler: Optional[Callable[[Exception], None]] = None,
+    exit_on_sending_failure: bool = False,
+    continue_waiting_for_data_sending_on_error: bool = False,
+) -> GlobalContext:
+    global _global_context
+    with _context_lock:
+        if _global_context is None:
+            _global_context = GlobalContext(
+                job_name,
+                current_party,
+                sending_failure_handler=sending_failure_handler,
+                exit_on_sending_failure=exit_on_sending_failure,
+                continue_waiting_for_data_sending_on_error=(
+                    continue_waiting_for_data_sending_on_error
+                ),
+            )
+        return _global_context
+
+
+def get_global_context() -> Optional[GlobalContext]:
+    return _global_context
+
+
+def clear_global_context(wait_for_sending: bool = False) -> None:
+    global _global_context
+    with _context_lock:
+        if _global_context is not None:
+            _global_context.get_cleanup_manager().stop(
+                wait_for_sending=wait_for_sending
+            )
+            _global_context.get_executor().shutdown(wait=False)
+            _global_context = None

@@ -1,0 +1,62 @@
+"""Job-scoped internal key-value store.
+
+Capability parity: the reference stores cluster/job config in Ray's GCS
+internal KV under job-scoped keys ``RAYFED#{job_name}#{key}``
+(ref ``fed/_private/compatible_utils.py:68-74,106-139``) so proxy actors in
+other processes can read them. Our proxies are threads in the party process,
+so the store is an in-process dict with the same prefixed-key contract and
+lifecycle (init once per job, ``reset`` on shutdown — behavior pinned by
+``fed/tests/test_internal_kv.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_store: Dict[str, bytes] = {}
+_lock = threading.Lock()
+_initialized_job: Optional[str] = None
+
+
+def wrap_kv_key(job_name: str, key: str) -> str:
+    """``FEDTPU#{job_name}#{key}`` (ref ``compatible_utils.py:68-74``)."""
+    from rayfed_tpu._private.constants import KV_NAMESPACE_PREFIX
+
+    return f"{KV_NAMESPACE_PREFIX}#{job_name}#{key}"
+
+
+def kv_initialize(job_name: str) -> bool:
+    global _initialized_job
+    with _lock:
+        _initialized_job = job_name
+        return True
+
+
+def kv_initialized() -> bool:
+    return _initialized_job is not None
+
+
+def kv_put(job_name: str, key: str, value: bytes) -> bool:
+    with _lock:
+        _store[wrap_kv_key(job_name, key)] = value
+        return True
+
+
+def kv_get(job_name: str, key: str) -> Optional[bytes]:
+    with _lock:
+        return _store.get(wrap_kv_key(job_name, key))
+
+
+def kv_delete(job_name: str, key: str) -> bool:
+    with _lock:
+        _store.pop(wrap_kv_key(job_name, key), None)
+        return True
+
+
+def kv_reset() -> None:
+    """Clear everything for this process (ref ``compatible_utils.py:179-186``)."""
+    global _initialized_job
+    with _lock:
+        _store.clear()
+        _initialized_job = None

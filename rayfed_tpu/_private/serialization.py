@@ -1,0 +1,230 @@
+"""Payload serialization: array fast path + whitelisted unpickling.
+
+Two lanes (SURVEY.md C15 + §7 "mixed payloads"):
+
+1. ``tree`` — the TPU-native fast path. A pytree whose containers are
+   msgpack-encodable and whose leaves are arrays / simple scalars is encoded
+   as a msgpack skeleton plus the raw array bytes concatenated — **zero
+   pickle on either end**. This is what 100MB gradient pushes ride; the
+   reference instead cloudpickles every payload
+   (ref ``fed/proxy/grpc/grpc_proxy.py:202,289-293``), which is both slower
+   and a security liability.
+2. ``pickle`` — fallback for arbitrary Python objects, guarded on the
+   receiver by a module/class whitelist unpickler, mirroring
+   ``fed/_private/serialization_utils.py:24-83`` (behavior pinned by
+   ``fed/tests/serializations_tests/test_unpickle_with_whitelist.py``).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+import numpy as np
+
+from rayfed_tpu import tree_util
+
+# Modules that are always unpicklable even under a whitelist: our own error
+# envelope must be able to cross (the peer re-raises it), and the exception
+# *types* it may wrap. Note: unlike a blanket ``builtins`` pass-through,
+# builtins are only admitted when the resolved object is an exception class —
+# ``builtins.eval``/``getattr`` stay forbidden.
+_ALWAYS_ALLOWED = {
+    "rayfed_tpu.exceptions": {"FedRemoteError", "FedLocalError"},
+}
+
+
+def dumps(obj: Any) -> bytes:
+    return cloudpickle.dumps(obj)
+
+
+class _WhitelistUnpickler(pickle.Unpickler):
+    def __init__(self, file, allowed: Dict[str, set]):
+        super().__init__(file)
+        self._allowed = allowed
+
+    def find_class(self, module: str, name: str):
+        for table in (self._allowed, _ALWAYS_ALLOWED):
+            names = table.get(module)
+            if names is not None and ("*" in names or name in names):
+                return super().find_class(module, name)
+        if module == "builtins":
+            obj = getattr(__import__("builtins"), name, None)
+            if isinstance(obj, type) and issubclass(obj, BaseException):
+                return obj
+        raise pickle.UnpicklingError(
+            f"global '{module}.{name}' is forbidden by the serialization "
+            "whitelist (serializing_allowed_list)."
+        )
+
+
+def restricted_loads(
+    data: bytes, allowed_list: Optional[Dict[str, List[str]]]
+) -> Any:
+    """Unpickle; if a whitelist is configured, only whitelisted globals load
+    (ref ``serialization_utils.py:66-83``)."""
+    if allowed_list is None:
+        return cloudpickle.loads(data)
+    allowed = {m: set(ns) for m, ns in allowed_list.items()}
+    return _WhitelistUnpickler(io.BytesIO(data), allowed).load()
+
+
+# ---------------------------------------------------------------------------
+# Array-tree fast path
+# ---------------------------------------------------------------------------
+
+_MSGPACK_SCALARS = (bool, int, float, str, bytes, type(None))
+
+
+def _array_buffer(arr: np.ndarray):
+    """A bytes-like for the raw contents of a C-contiguous array. Zero-copy
+    (memoryview) when the buffer protocol supports the dtype; falls back to
+    a copy for exotic dtypes (bfloat16, float8) and 0-d/empty arrays."""
+    if arr.nbytes == 0:
+        return b""
+    try:
+        return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        return arr.tobytes()
+
+
+def buffer_nbytes(buf) -> int:
+    return memoryview(buf).nbytes
+
+
+def concat_buffers(buffers) -> bytes:
+    return b"".join(bytes(memoryview(b)) if not isinstance(b, bytes) else b
+                    for b in buffers)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # registers bfloat16/float8 etc.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_array_leaf(x: Any) -> bool:
+    # Covers numpy, jax.Array, torch.Tensor without importing any of them.
+    return hasattr(x, "shape") and hasattr(x, "dtype") and hasattr(x, "__array__")
+
+
+def _spec_to_wire(spec: tree_util.TreeSpec) -> Optional[dict]:
+    if spec.kind == "namedtuple":
+        return None  # type object not wire-encodable -> caller falls back
+    meta = spec.meta
+    if spec.kind in ("dict", "odict"):
+        if not all(isinstance(k, (str, int)) for k in meta):
+            return None
+        meta = list(meta)
+    children = []
+    for c in spec.children:
+        w = _spec_to_wire(c)
+        if w is None:
+            return None
+        children.append(w)
+    return {"k": spec.kind, "m": meta, "c": children}
+
+
+def _spec_from_wire(w: dict) -> tree_util.TreeSpec:
+    return tree_util.TreeSpec(
+        w["k"], w["m"], tuple(_spec_from_wire(c) for c in w["c"])
+    )
+
+
+def try_encode_tree(data: Any) -> Optional[Tuple[dict, List[Any]]]:
+    """Attempt the zero-pickle encoding.
+
+    Returns (meta, buffers) or None if the payload needs pickling. ``meta``
+    is msgpack-encodable; ``buffers`` is a list of byte-like objects to be
+    written after the header (no concatenation of large arrays).
+    """
+    leaves, spec = tree_util.tree_flatten(data)
+    wire_spec = _spec_to_wire(spec)
+    if wire_spec is None:
+        return None
+    descs = []
+    buffers: List[Any] = []
+    offset = 0
+    for leaf in leaves:
+        if _is_array_leaf(leaf):
+            arr = np.asarray(leaf)  # device->host for jax arrays
+            if arr.dtype == object:
+                return None
+            if not arr.flags["C_CONTIGUOUS"]:
+                arr = np.ascontiguousarray(arr)
+            buf = _array_buffer(arr)
+            descs.append(
+                {
+                    "t": "arr",
+                    "dtype": arr.dtype.name,
+                    "shape": list(arr.shape),
+                    "off": offset,
+                    "n": arr.nbytes,
+                }
+            )
+            buffers.append(buf)
+            offset += arr.nbytes
+        elif isinstance(leaf, _MSGPACK_SCALARS):
+            if isinstance(leaf, int) and abs(leaf) >= 2**63:
+                return None
+            descs.append({"t": "obj", "v": leaf})
+        else:
+            return None
+    meta = {"spec": wire_spec, "leaves": descs}
+    try:
+        msgpack.packb(meta, use_bin_type=True)
+    except Exception:  # noqa: BLE001 - any unpackable meta -> pickle lane
+        return None
+    return meta, buffers
+
+
+def decode_tree(meta: dict, payload) -> Any:
+    """Inverse of :func:`try_encode_tree`. ``payload`` is a bytes-like of the
+    concatenated buffers; array leaves are materialized as numpy views
+    (zero-copy) — the TPU transport then ``jax.device_put``s them onto the
+    party mesh."""
+    view = memoryview(payload)
+    spec = _spec_from_wire(meta["spec"])
+    leaves = []
+    for d in meta["leaves"]:
+        if d["t"] == "arr":
+            dtype = _np_dtype(d["dtype"])
+            raw = view[d["off"]: d["off"] + d["n"]]
+            arr = np.frombuffer(raw, dtype=dtype).reshape(d["shape"])
+            leaves.append(arr)
+        else:
+            leaves.append(d["v"])
+    return tree_util.tree_unflatten(leaves, spec)
+
+
+def encode_payload(data: Any) -> Tuple[str, bytes, List[Any]]:
+    """Encode any payload for the wire.
+
+    Returns (kind, meta_bytes, buffers): kind in {"tree", "pickle"};
+    meta_bytes is msgpack (tree) or empty (pickle); buffers are written
+    after the frame header in order.
+    """
+    enc = try_encode_tree(data)
+    if enc is not None:
+        meta, buffers = enc
+        return "tree", msgpack.packb(meta, use_bin_type=True), buffers
+    return "pickle", b"", [dumps(data)]
+
+
+def decode_payload(
+    kind: str,
+    meta_bytes: bytes,
+    payload,
+    allowed_list: Optional[Dict[str, List[str]]] = None,
+) -> Any:
+    if kind == "tree":
+        return decode_tree(msgpack.unpackb(meta_bytes, raw=False), payload)
+    if kind == "pickle":
+        return restricted_loads(bytes(payload), allowed_list)
+    raise ValueError(f"unknown payload kind: {kind}")

@@ -1,0 +1,425 @@
+"""Public API: init / remote / get / kill / shutdown.
+
+Capability parity: reference ``fed/api.py`` —
+``init`` (api.py:67-297), ``shutdown``/``_shutdown`` (299-361),
+``remote`` decorator + FedRemoteFunction/FedRemoteClass (384-528),
+``get`` (531-608), ``kill`` (611-623), SIGINT hook (53-64,233).
+
+Differences (TPU-native substrate, SURVEY.md §7):
+ - no Ray: tasks run on the party-local executor, actors on serial lanes;
+ - default transport is the native TCP data plane with the array fast path
+   (``transport='tcp'``); ``transport='tpu'`` additionally places received
+   arrays onto the party's device mesh; ``transport='grpc'`` is the
+   reference-parity lane kept for benchmarking;
+ - ``init`` may bind the party to a TPU sub-mesh via
+   ``config['party_mesh']`` (device_ids / mesh_shape / axis_names).
+"""
+
+from __future__ import annotations
+
+import inspect
+import logging
+import pickle
+import signal
+import sys
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import rayfed_tpu._private.constants as constants
+import rayfed_tpu.config as fed_config
+import rayfed_tpu.utils as fed_utils
+from rayfed_tpu._private import kv as internal_kv
+from rayfed_tpu._private.call_holder import FedCallHolder
+from rayfed_tpu._private.fed_actor import FedActorHandle
+from rayfed_tpu._private.global_context import (
+    clear_global_context,
+    get_global_context,
+    init_global_context,
+)
+from rayfed_tpu.config import CrossSiloMessageConfig
+from rayfed_tpu.exceptions import FedRemoteError
+from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.proxy import barriers
+from rayfed_tpu.utils import setup_logger
+
+logger = logging.getLogger(__name__)
+
+original_sigint = signal.getsignal(signal.SIGINT)
+
+
+def _signal_handler(signum, frame):
+    if signum == signal.SIGINT:
+        signal.signal(signal.SIGINT, original_sigint)
+        logger.warning(
+            "Stop signal received (e.g. via SIGINT/Ctrl+C), "
+            "try to shutdown fed. Press CTRL+C "
+            "(or send SIGINT/SIGKILL/SIGTERM) to skip."
+        )
+        _shutdown(intended=False)
+
+
+def init(
+    addresses: Optional[Dict[str, str]] = None,
+    party: Optional[str] = None,
+    config: Optional[Dict] = None,
+    tls_config: Optional[Dict] = None,
+    logging_level: str = "info",
+    sender_proxy_cls=None,
+    receiver_proxy_cls=None,
+    job_name: Optional[str] = None,
+    sending_failure_handler: Optional[Callable[[Exception], None]] = None,
+    transport: Optional[str] = None,
+):
+    """Initialize this party's fed runtime.
+
+    Args:
+        addresses: ``{party: "host:port"}`` for every party in the job.
+        party: this party's name (must be a key of ``addresses``).
+        config: job configuration dict; supported keys:
+            ``cross_silo_comm`` (see :class:`CrossSiloMessageConfig` /
+            :class:`~rayfed_tpu.config.TcpCrossSiloMessageConfig`),
+            ``barrier_on_initializing`` (bool: block until all parties are
+            reachable), ``party_mesh`` (TPU device topology for this party,
+            see :class:`~rayfed_tpu.config.PartyMeshConfig`).
+        tls_config: ``{ca_cert, cert, key}`` file paths for mutual TLS.
+        logging_level: root logging level.
+        sender_proxy_cls / receiver_proxy_cls: custom transport classes
+            (the pluggable seam, ref api.py:73-75).
+        job_name: multi-job isolation name; peers in other jobs get 417.
+        sending_failure_handler: called with the last sending error on
+            unintended shutdown.
+        transport: 'tcp' (default), 'tpu', or 'grpc'.
+    """
+    assert addresses, "Addresses should be provided."
+    assert party, "Party should be provided."
+    assert party in addresses, f"Party {party} is not in the addresses {addresses}."
+    config = config or {}
+
+    if job_name is None:
+        job_name = constants.DEFAULT_JOB_NAME
+
+    fed_utils.validate_addresses(addresses)
+
+    cross_silo_comm_dict = config.get("cross_silo_comm", {})
+    cross_silo_comm_config = CrossSiloMessageConfig.from_dict(cross_silo_comm_dict)
+
+    init_global_context(
+        job_name=job_name,
+        current_party=party,
+        sending_failure_handler=sending_failure_handler,
+        exit_on_sending_failure=cross_silo_comm_config.exit_on_sending_failure,
+        continue_waiting_for_data_sending_on_error=(
+            cross_silo_comm_config.continue_waiting_for_data_sending_on_error
+        ),
+    )
+
+    tls_config = {} if tls_config is None else tls_config
+    if tls_config:
+        assert (
+            "cert" in tls_config and "key" in tls_config
+        ), "Cert or key are not in tls_config."
+
+    internal_kv.kv_initialize(job_name)
+    cluster_config = {
+        constants.KEY_OF_CLUSTER_ADDRESSES: addresses,
+        constants.KEY_OF_CURRENT_PARTY_NAME: party,
+        constants.KEY_OF_TLS_CONFIG: tls_config,
+    }
+    internal_kv.kv_put(
+        job_name, constants.KEY_OF_CLUSTER_CONFIG, pickle.dumps(cluster_config)
+    )
+    job_config = {
+        constants.KEY_OF_CROSS_SILO_COMM_CONFIG_DICT: cross_silo_comm_dict,
+    }
+    internal_kv.kv_put(
+        job_name, constants.KEY_OF_JOB_CONFIG, pickle.dumps(job_config)
+    )
+
+    setup_logger(
+        logging_level=logging_level,
+        logging_format=constants.LOG_FORMAT,
+        party_val=party,
+        job_name=job_name,
+    )
+    logger.info("Started rayfed_tpu with %s", cluster_config)
+
+    signal.signal(signal.SIGINT, _signal_handler)
+    get_global_context().get_cleanup_manager().start(
+        exit_on_sending_failure=cross_silo_comm_config.exit_on_sending_failure,
+        expose_error_trace=cross_silo_comm_config.expose_error_trace,
+    )
+
+    transport = transport or config.get("transport", "tcp")
+
+    # Optional TPU binding: establish the party's device mesh before any
+    # task is jit-compiled on it (SURVEY.md §3.1 "In a TPU build `init`
+    # additionally establishes the party-slice mesh").
+    party_mesh_dict = config.get("party_mesh")
+    if party_mesh_dict is not None or transport == "tpu":
+        from rayfed_tpu.mesh import init_party_mesh
+
+        init_party_mesh(fed_config.PartyMeshConfig.from_dict(party_mesh_dict))
+    default_sender_cls, default_receiver_cls = barriers._default_transport_classes(
+        transport
+    )
+    receiver_proxy_cls = receiver_proxy_cls or default_receiver_cls
+    sender_proxy_cls = sender_proxy_cls or default_sender_cls
+
+    barriers.start_receiver_proxy(
+        addresses=addresses,
+        party=party,
+        job_name=job_name,
+        tls_config=tls_config,
+        proxy_cls=receiver_proxy_cls,
+        proxy_config=cross_silo_comm_dict,
+        ready_timeout_s=cross_silo_comm_config.timeout_in_ms / 1000,
+    )
+    barriers.start_sender_proxy(
+        addresses=addresses,
+        party=party,
+        job_name=job_name,
+        tls_config=tls_config,
+        proxy_cls=sender_proxy_cls,
+        proxy_config=cross_silo_comm_dict,
+    )
+
+    if config.get("barrier_on_initializing", False):
+        barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
+
+
+def shutdown():
+    """Intended shutdown (ref api.py:299-306): wins the shutdown-once flag,
+    drains pending sends, then tears the runtime down."""
+    ctx = get_global_context()
+    if ctx is not None and ctx.acquire_shutdown_flag():
+        _shutdown(True)
+
+
+def _shutdown(intended: bool = True):
+    if get_global_context() is None:
+        return
+
+    if intended:
+        logger.info("Shutting down rayfed_tpu intendedly...")
+    else:
+        logger.warning("Shutting down rayfed_tpu unintendedly...")
+    ctx = get_global_context()
+    last_sending_error = ctx.get_cleanup_manager().get_last_sending_error()
+    last_received_error = ctx.get_last_received_error()
+    if last_sending_error is not None:
+        logger.error("Cross-silo sending error occurred. %s", last_sending_error)
+
+    wait_for_sending = True
+    if (
+        last_sending_error is not None or last_received_error is not None
+    ) and not ctx.get_continue_waiting_for_data_sending_on_error():
+        wait_for_sending = False
+    logger.info(
+        "%s for data sending.", "Wait" if wait_for_sending else "No wait"
+    )
+
+    exit_on_sending_failure = False
+    if not intended:
+        failure_handler = ctx.get_sending_failure_handler()
+        if failure_handler is not None:
+            logger.info("Executing failure handler %s ...", failure_handler)
+            failure_handler(last_sending_error)
+        exit_on_sending_failure = ctx.get_exit_on_sending_failure()
+
+    internal_kv.kv_reset()
+    clear_global_context(wait_for_sending=wait_for_sending)
+    barriers.stop_proxies()
+    fed_config.reset_config_cache()
+    logger.info("Shutdown rayfed_tpu.")
+    signal.signal(signal.SIGINT, original_sigint)
+    if exit_on_sending_failure:
+        logger.critical("Exit now due to the previous error.")
+        sys.exit(1)
+
+
+def _get_addresses(job_name: str) -> Dict[str, str]:
+    cfg = fed_config.get_cluster_config(job_name)
+    return cfg.cluster_addresses if cfg else {}
+
+
+def _get_party(job_name: str) -> str:
+    cfg = fed_config.get_cluster_config(job_name)
+    return cfg.current_party if cfg else ""
+
+
+def _get_tls(job_name: str) -> Dict:
+    cfg = fed_config.get_cluster_config(job_name)
+    return cfg.tls_config if cfg else {}
+
+
+class FedRemoteFunction:
+    """`@fed.remote` over a function (ref api.py:384-417)."""
+
+    def __init__(self, func_or_class) -> None:
+        self._node_party = None
+        self._func_body = func_or_class
+        self._options: Dict[str, Any] = {}
+        self._fed_call_holder = None
+
+    def party(self, party: str):
+        self._node_party = party
+        self._fed_call_holder = FedCallHolder(
+            self._node_party, self._execute_impl, self._options
+        )
+        return self
+
+    def options(self, **options):
+        self._options = options
+        if self._fed_call_holder:
+            self._fed_call_holder.options(**options)
+        return self
+
+    def remote(self, *args, **kwargs):
+        if not self._node_party:
+            raise ValueError("You should specify a party name on the fed function.")
+        return self._fed_call_holder.internal_remote(*args, **kwargs)
+
+    def _execute_impl(self, args, kwargs):
+        return get_global_context().get_executor().submit(
+            self._func_body,
+            args,
+            kwargs,
+            num_returns=self._options.get("num_returns", 1),
+        )
+
+
+class FedRemoteClass:
+    """`@fed.remote` over a class (ref api.py:433-448)."""
+
+    def __init__(self, func_or_class) -> None:
+        self._party = None
+        self._cls = func_or_class
+        self._options: Dict[str, Any] = {}
+
+    def party(self, party: str):
+        self._party = party
+        return self
+
+    def options(self, **options):
+        self._options = options
+        return self
+
+    def remote(self, *cls_args, **cls_kwargs) -> FedActorHandle:
+        fed_class_task_id = get_global_context().next_seq_id()
+        job_name = get_global_context().get_job_name()
+        fed_actor_handle = FedActorHandle(
+            fed_class_task_id,
+            _get_addresses(job_name),
+            self._cls,
+            _get_party(job_name),
+            self._party,
+            self._options,
+        )
+        fed_call_holder = FedCallHolder(
+            self._party, fed_actor_handle._execute_impl, self._options
+        )
+        fed_call_holder.internal_remote(*cls_args, **cls_kwargs)
+        return fed_actor_handle
+
+
+def remote(*args, **kwargs):
+    """Define a fed task or fed actor (ref api.py:452-528).
+
+    Usable bare (``@fed.remote``) or with options
+    (``@fed.remote(num_returns=2)``).
+    """
+
+    def _make_fed_remote(function_or_class, **options):
+        if inspect.isfunction(function_or_class) or fed_utils_is_cython(
+            function_or_class
+        ):
+            return FedRemoteFunction(function_or_class).options(**options)
+        if inspect.isclass(function_or_class):
+            return FedRemoteClass(function_or_class).options(**options)
+        raise TypeError(
+            "The @fed.remote decorator must be applied to either a function "
+            "or a class."
+        )
+
+    if len(args) == 1 and len(kwargs) == 0 and callable(args[0]):
+        return _make_fed_remote(args[0])
+    assert len(args) == 0 and len(kwargs) > 0, "Remote args error."
+    return lambda fn_or_cls: _make_fed_remote(fn_or_cls, **kwargs)
+
+
+def fed_utils_is_cython(obj) -> bool:
+    """Cython callables are functions too (ref ``fed/utils.py:166-179``)."""
+    def check(x):
+        return (
+            hasattr(x, "__func__")
+            and "cython" in type(x.__func__).__name__.lower()
+        ) or "cython" in type(x).__name__.lower()
+
+    return check(obj)
+
+
+def get(
+    fed_objects: Union[FedObject, List[FedObject]],
+) -> Any:
+    """Resolve FedObjects to real values; the owner broadcasts to every
+    other party (ref api.py:531-608 — `get` is itself a DAG node with a
+    fresh seq id so all parties address the same edges)."""
+    fake_fed_task_id = get_global_context().next_seq_id()
+    job_name = get_global_context().get_job_name()
+    addresses = _get_addresses(job_name)
+    current_party = _get_party(job_name)
+    is_individual_id = isinstance(fed_objects, FedObject)
+    if is_individual_id:
+        fed_objects = [fed_objects]
+
+    futures = []
+    for fed_object in fed_objects:
+        if fed_object.get_party() == current_party:
+            fut = fed_object.get_value_future()
+            assert fut is not None
+            futures.append(fut)
+            for party_name in addresses:
+                if party_name == current_party:
+                    continue
+                if fed_object._was_sending_or_sent_to_party(party_name):
+                    continue
+                fed_object._mark_is_sending_to_party(party_name)
+                barriers.send(
+                    dest_party=party_name,
+                    data=fut,
+                    upstream_seq_id=fed_object.get_fed_task_id(),
+                    downstream_seq_id=fake_fed_task_id,
+                )
+        else:
+            if fed_object.get_value_future() is not None:
+                fut = fed_object.get_value_future()
+            else:
+                fut = barriers.recv(
+                    current_party,
+                    fed_object.get_party(),
+                    fed_object.get_fed_task_id(),
+                    fake_fed_task_id,
+                )
+                fed_object._cache_value_future(fut)
+            futures.append(fut)
+
+    try:
+        values = [f.result() for f in futures]
+        if is_individual_id:
+            values = values[0]
+        return values
+    except FedRemoteError as e:
+        logger.warning(
+            "Encountered RemoteError from another party, error message: %s",
+            e.cause,
+        )
+        if get_global_context() is not None:
+            get_global_context().set_last_received_error(e)
+        raise
+
+
+def kill(actor: FedActorHandle, *, no_restart: bool = True):
+    """Kill a fed actor in its party (ref api.py:611-623)."""
+    job_name = get_global_context().get_job_name()
+    current_party = _get_party(job_name)
+    if actor._node_party == current_party:
+        actor._kill()

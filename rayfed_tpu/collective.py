@@ -1,0 +1,144 @@
+"""Cross-party collective lanes: federated aggregation as XLA collectives.
+
+SURVEY.md §7 stage 5 and the BASELINE.json north star: FedAvg weight
+aggregation lowers to a cross-slice ``psum`` over a *joint* mesh whose
+leading axis enumerates parties, instead of point-to-point pushes.
+
+Two deployment shapes:
+
+ - **Joint-process lane** (this module): every party's shard lives in one
+   JAX process group (a real multi-slice pod with ``jax.distributed``, the
+   driver's multi-chip dry-run, or CPU simulation). ``cross_party_mean``
+   runs one ``shard_map`` program where each party's sub-mesh holds its own
+   weights and one ``psum`` over the party axis produces the aggregate —
+   bitwise-identical on every party because XLA reduces in a fixed ring
+   order.
+ - **Push lane** (the default engine path): parties in separate processes
+   push weight trees over the data plane and reduce with
+   :func:`rayfed_tpu.ops.aggregate.tree_mean` — same math, pinned
+   accumulation dtype, deterministic fold order.
+
+The data-perimeter asymmetry (owner pushes, SURVEY.md §7 "hard parts") is
+preserved at the API layer: a party enters ``cross_party_mean`` only by
+executing the same program line — exactly the multi-controller opt-in the
+push lane has.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.7 exposes shard_map at the top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+
+def party_axis_mesh(n_parties: int, devices=None, inner_axes=("data",),
+                    inner_shape=None):
+    """Build a joint mesh with a leading ``party`` axis.
+
+    Default: shape (n_parties, n_devices/n_parties) with one inner axis,
+    e.g. 8 devices, 2 parties -> ('party': 2, 'data': 4). For multi-axis
+    party sub-meshes pass matching ``inner_axes`` and ``inner_shape``, e.g.
+    ``inner_axes=("data", "model"), inner_shape=(2, 2)``. Each party's
+    slice is ``mesh.devices[p]``.
+    """
+    import math
+
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n % n_parties != 0:
+        raise ValueError(f"{n} devices not divisible by {n_parties} parties")
+    inner_total = n // n_parties
+    if inner_shape is None:
+        if len(inner_axes) != 1:
+            raise ValueError(
+                "inner_shape is required when inner_axes has more than one axis"
+            )
+        inner_shape = (inner_total,)
+    if len(inner_shape) != len(inner_axes):
+        raise ValueError(f"{inner_axes=} does not match {inner_shape=}")
+    if math.prod(inner_shape) != inner_total:
+        raise ValueError(
+            f"inner_shape {inner_shape} must cover {inner_total} devices/party"
+        )
+    dev = np.array(devices).reshape((n_parties,) + tuple(inner_shape))
+    return Mesh(dev, ("party",) + tuple(inner_axes))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "party_axis", "op", "acc_dtype")
+)
+def _cross_party_reduce(tree, mesh: Mesh, party_axis: str, op: str,
+                        acc_dtype: Optional[str]):
+    n_parties = mesh.shape[party_axis]
+    other_axes = tuple(a for a in mesh.axis_names if a != party_axis)
+
+    def body(local_tree):
+        def leaf(x):
+            orig = x.dtype
+            if acc_dtype is not None:
+                x = x.astype(acc_dtype)
+            s = jax.lax.psum(x, axis_name=party_axis)
+            if op == "mean":
+                s = s / n_parties
+            return s.astype(orig)
+
+        return jax.tree_util.tree_map(leaf, local_tree)
+
+    # Party-sharded in, party-sharded (replicated value) out: every party's
+    # sub-mesh ends up holding the identical aggregate.
+    spec = P(party_axis)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+    )(tree)
+
+
+def cross_party_reduce(tree, mesh: Mesh, party_axis: str = "party",
+                       op: str = "mean", acc_dtype: Optional[str] = "float32"):
+    """Reduce a pytree whose leaves carry a leading party dimension sharded
+    over ``party_axis``; each party's slot receives the aggregate.
+
+    Leaves must have shape ``(n_parties, ...)`` with the leading dim sharded
+    on the party axis (use :func:`stack_party_tree` to build them).
+    """
+    assert op in ("mean", "sum"), op
+    return _cross_party_reduce(tree, mesh, party_axis, op, acc_dtype)
+
+
+def stack_party_tree(per_party_trees, mesh: Mesh, party_axis: str = "party"):
+    """Stack per-party weight trees along a new leading axis and shard that
+    axis over the party sub-meshes (host staging lane, used in simulation
+    and tests; on a real pod each party's shard is already device-resident)."""
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_party_trees
+    )
+    sharding = NamedSharding(mesh, P(party_axis))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), stacked
+    )
+
+
+def cross_party_mean(per_party_trees, mesh: Optional[Mesh] = None,
+                     party_axis: str = "party"):
+    """One-call FedAvg over the joint mesh: stack, psum, unstack.
+
+    Returns the aggregate tree (identical content in every party slot).
+    """
+    if mesh is None:
+        mesh = party_axis_mesh(len(per_party_trees))
+    stacked = stack_party_tree(per_party_trees, mesh, party_axis)
+    reduced = cross_party_reduce(stacked, mesh, party_axis, op="mean")
+    # Every party slot now holds the aggregate; slot 0 is representative.
+    return jax.tree_util.tree_map(lambda x: x[0], reduced)

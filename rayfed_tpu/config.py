@@ -1,0 +1,203 @@
+"""Configuration system.
+
+Capability parity: reference ``fed/config.py`` — cluster config (addresses /
+current party / TLS) and job config stored in the job-scoped KV so that
+transport proxies re-read them from the store rather than from driver
+globals (ref ``fed/proxy/barriers.py:137-140,209-212``), plus dataclasses
+for cross-silo messaging knobs with ``from_dict`` filtering unknown keys
+(ref ``fed/config.py:147-161``).
+
+TPU extension: ``ClusterConfig`` additionally carries a per-party device
+topology (``party_mesh_config``) — which local devices form this party's
+mesh and the logical axis layout (SURVEY.md C8 "adds mesh/slice topology").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+from typing import Any, Dict, List, Optional
+
+import rayfed_tpu._private.constants as constants
+from rayfed_tpu._private import kv as internal_kv
+
+
+class ClusterConfig:
+    """Wire-stored cluster-level config (ref ``fed/config.py:15-31``)."""
+
+    def __init__(self, raw_bytes: bytes) -> None:
+        self._data = pickle.loads(raw_bytes)
+
+    @property
+    def cluster_addresses(self) -> Dict[str, str]:
+        return self._data[constants.KEY_OF_CLUSTER_ADDRESSES]
+
+    @property
+    def current_party(self) -> str:
+        return self._data[constants.KEY_OF_CURRENT_PARTY_NAME]
+
+    @property
+    def tls_config(self) -> Dict:
+        return self._data[constants.KEY_OF_TLS_CONFIG]
+
+
+class JobConfig:
+    def __init__(self, raw_bytes: Optional[bytes]) -> None:
+        self._data = {} if raw_bytes is None else pickle.loads(raw_bytes)
+
+    @property
+    def cross_silo_comm_config_dict(self) -> Dict:
+        return self._data.get(constants.KEY_OF_CROSS_SILO_COMM_CONFIG_DICT, {})
+
+
+# Module-level lazy caches (ref fed/config.py:46-75).
+_cluster_config: Optional[ClusterConfig] = None
+_job_config: Optional[JobConfig] = None
+
+
+def get_cluster_config(job_name: str) -> Optional[ClusterConfig]:
+    global _cluster_config
+    if _cluster_config is None:
+        raw = internal_kv.kv_get(job_name, constants.KEY_OF_CLUSTER_CONFIG)
+        if raw is None:
+            return None
+        _cluster_config = ClusterConfig(raw)
+    return _cluster_config
+
+
+def get_job_config(job_name: str) -> JobConfig:
+    global _job_config
+    if _job_config is None:
+        raw = internal_kv.kv_get(job_name, constants.KEY_OF_JOB_CONFIG)
+        _job_config = JobConfig(raw)
+    return _job_config
+
+
+def reset_config_cache() -> None:
+    global _cluster_config, _job_config
+    _cluster_config = None
+    _job_config = None
+
+
+@dataclasses.dataclass
+class CrossSiloMessageConfig:
+    """Transport-independent cross-party messaging knobs
+    (ref ``fed/config.py:78-161``).
+
+    Ray-specific reference knobs (``proxy_max_restarts``,
+    ``send_resource_label``, ``recv_resource_label``, ``use_global_proxy`` —
+    ref config.py:98-124) have no meaning for in-process thread proxies;
+    ``from_dict`` silently drops them, so reference-written config dicts
+    still load.
+
+    Attributes:
+        timeout_in_ms: per-send timeout (ref default 60000, config.py:126).
+        messages_max_size_in_bytes: max payload size; None = unlimited
+            (the reference caps gRPC at 500MB, grpc_options.py:28-29).
+        serializing_allowed_list: {module: [class, ...]} whitelist for
+            unpickling received non-array payloads.
+        exit_on_sending_failure: SIGINT self when a push ultimately fails.
+        expose_error_trace: include the real exception in the
+            FedRemoteError envelope sent to peers.
+        continue_waiting_for_data_sending_on_error: keep draining queued
+            sends during shutdown even after an error was seen.
+    """
+
+    timeout_in_ms: int = 60000
+    messages_max_size_in_bytes: Optional[int] = None
+    serializing_allowed_list: Optional[Dict[str, List[str]]] = None
+    exit_on_sending_failure: Optional[bool] = False
+    expose_error_trace: Optional[bool] = False
+    continue_waiting_for_data_sending_on_error: Optional[bool] = False
+
+    def __json__(self) -> str:
+        import json
+
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, json_str: str) -> "CrossSiloMessageConfig":
+        import json
+
+        return cls.from_dict(json.loads(json_str))
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "CrossSiloMessageConfig":
+        """Construct from a dict, silently dropping unknown keys
+        (ref ``fed/config.py:147-161``)."""
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in field_names})
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Connection/send retry policy, mirroring the reference's gRPC service
+    config defaults (ref ``grpc_options.py:19-25``): 5 attempts, 5s initial
+    backoff, 30s cap, x2 multiplier."""
+
+    max_attempts: int = 5
+    initial_backoff_ms: int = 5000
+    max_backoff_ms: int = 30000
+    backoff_multiplier: float = 2.0
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "RetryPolicy":
+        data = data or {}
+        # Accept the reference's camelCase gRPC retry keys too.
+        alias = {
+            "maxAttempts": "max_attempts",
+            "initialBackoff": "initial_backoff_ms",
+            "maxBackoff": "max_backoff_ms",
+            "backoffMultiplier": "backoff_multiplier",
+        }
+
+        def conv(k: str, v: Any) -> Any:
+            if k in ("initialBackoff", "maxBackoff") and isinstance(v, str):
+                return int(float(v.rstrip("s")) * 1000)
+            return v
+
+        norm = {alias.get(k, k): conv(k, v) for k, v in data.items()}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in norm.items() if k in field_names})
+
+
+@dataclasses.dataclass
+class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
+    """Knobs specific to the native TCP transport (our default data plane,
+    replacing the reference's gRPC channel options,
+    ref ``fed/config.py:164-195``)."""
+
+    retry_policy: Optional[Dict[str, Any]] = None
+    connect_timeout_in_ms: int = 10000
+    # Chunk size for socket writes of large payloads.
+    write_chunk_bytes: int = 4 * 1024 * 1024
+
+    def get_retry_policy(self) -> RetryPolicy:
+        return RetryPolicy.from_dict(self.retry_policy)
+
+
+# Back-compat alias: the reference spells this GrpcCrossSiloMessageConfig.
+GrpcCrossSiloMessageConfig = TcpCrossSiloMessageConfig
+
+
+@dataclasses.dataclass
+class PartyMeshConfig:
+    """TPU topology for one party (no reference equivalent — TPU-native).
+
+    Attributes:
+        device_ids: indices into ``jax.devices()`` forming this party's mesh
+            (None = all local devices).
+        mesh_shape: logical mesh shape over those devices.
+        axis_names: logical axis names, e.g. ("data", "model").
+    """
+
+    device_ids: Optional[List[int]] = None
+    mesh_shape: Optional[List[int]] = None
+    axis_names: Optional[List[str]] = None
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "PartyMeshConfig":
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in field_names})

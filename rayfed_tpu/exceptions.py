@@ -1,0 +1,63 @@
+"""Cross-party error envelopes.
+
+Capability parity: the reference's ``FedRemoteError`` is the single typed
+envelope in which one party's task/actor failure travels to every other party
+(reference: ``fed/exceptions.py:16-25``; produced by the cleanup drain at
+``fed/cleanup.py:160-172`` and re-raised out of the receiver at
+``fed/proxy/barriers.py:227-234``).
+"""
+
+from __future__ import annotations
+
+
+class FedRemoteError(Exception):
+    """An error raised in one party, delivered to a peer under the same
+    (upstream_seq_id, downstream_seq_id) rendezvous the peer is waiting on.
+
+    ``cause`` is the original exception if the sending party exposed the
+    trace (``expose_error_trace=True``), otherwise a string summary — the
+    same privacy knob as the reference (``fed/cleanup.py:160-167``).
+    """
+
+    def __init__(self, src_party: str, cause):
+        self._src_party = src_party
+        self._cause = cause
+
+    @property
+    def src_party(self) -> str:
+        return self._src_party
+
+    @property
+    def cause(self):
+        return self._cause
+
+    def __str__(self) -> str:
+        return (
+            f"FedRemoteError occurred at party {self._src_party}."
+            f" Caused by {self._cause!r}."
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return self.__str__()
+
+
+class FedLocalError(Exception):
+    """Wrapper distinguishing a *local* task failure from a remote envelope
+    when both flow through the same send lane (our design; the reference
+    conflates these inside the cleanup thread)."""
+
+    def __init__(self, cause: BaseException):
+        self._cause = cause
+
+    @property
+    def cause(self) -> BaseException:
+        return self._cause
+
+    def __str__(self) -> str:
+        return f"FedLocalError caused by {self._cause!r}"
+
+
+class FedActorKilledError(Exception):
+    """Raised by method futures of an actor that was ``fed.kill``-ed before
+    they could run (the analogue of Ray's RayActorError fail-fast semantics,
+    ref ``fed/api.py:611-623``)."""

@@ -1,0 +1,84 @@
+"""Party device-mesh management (TPU-native; no reference equivalent).
+
+``fed.init`` binds each party to a sub-mesh of the local devices (SURVEY.md
+§3.1: "In a TPU build `init` additionally establishes the party-slice
+mesh"). Party-local tasks jit onto this mesh; the TPU transport places
+received arrays onto it; federated aggregation uses the joint mesh helpers
+in :mod:`rayfed_tpu.collective`.
+
+JAX is imported lazily: control-plane-only processes never pay for it.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List, Optional
+
+from rayfed_tpu.config import PartyMeshConfig
+
+logger = logging.getLogger(__name__)
+
+_party_mesh = None
+_party_mesh_config: Optional[PartyMeshConfig] = None
+
+
+def build_mesh(
+    device_ids: Optional[List[int]] = None,
+    mesh_shape: Optional[List[int]] = None,
+    axis_names: Optional[List[str]] = None,
+):
+    """Create a ``jax.sharding.Mesh`` over the selected local devices.
+
+    Defaults: all local devices, 1-D mesh on axis ``("data",)``.
+    """
+    import jax
+    import numpy as np
+
+    devices = jax.devices()
+    if device_ids is not None:
+        devices = [devices[i] for i in device_ids]
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = [n]
+    if math.prod(mesh_shape) != n:
+        raise ValueError(
+            f"mesh_shape {mesh_shape} does not cover {n} devices"
+        )
+    if axis_names is None:
+        default_names = ["data", "model", "seq", "expert"]
+        axis_names = default_names[: len(mesh_shape)]
+        if len(axis_names) < len(mesh_shape):
+            axis_names += [f"ax{i}" for i in range(len(axis_names), len(mesh_shape))]
+    from jax.sharding import Mesh
+
+    dev_array = np.array(devices).reshape(mesh_shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def init_party_mesh(cfg: Optional[PartyMeshConfig] = None):
+    """Establish this party's mesh once, at ``fed.init`` time."""
+    global _party_mesh, _party_mesh_config
+    cfg = cfg or PartyMeshConfig()
+    _party_mesh = build_mesh(cfg.device_ids, cfg.mesh_shape, cfg.axis_names)
+    _party_mesh_config = cfg
+    logger.info(
+        "Party mesh established: shape=%s axes=%s",
+        dict(zip(_party_mesh.axis_names, _party_mesh.devices.shape)),
+        _party_mesh.axis_names,
+    )
+    return _party_mesh
+
+
+def get_party_mesh():
+    return _party_mesh
+
+
+def get_party_mesh_config() -> Optional[PartyMeshConfig]:
+    return _party_mesh_config
+
+
+def clear_party_mesh() -> None:
+    global _party_mesh, _party_mesh_config
+    _party_mesh = None
+    _party_mesh_config = None

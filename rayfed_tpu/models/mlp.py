@@ -1,0 +1,68 @@
+"""Logistic regression / MLP models (functional JAX).
+
+The reference ships no models — federated learning is user code
+(``README.md:59-104``). These are the model families the BASELINE.json
+bench configs name (2-party FedAvg logistic regression at MNIST shapes) and
+the building blocks for federated examples/tests.
+
+TPU-first notes: pure functional params-pytree style (no framework
+classes), bf16-friendly matmuls sized for the MXU, batch dimension laid out
+for ``data``-axis sharding on the party mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_logreg(rng, dim: int, classes: int, dtype=jnp.float32) -> Params:
+    wkey, _ = jax.random.split(rng)
+    return {
+        "w": (jax.random.normal(wkey, (dim, classes)) * 0.01).astype(dtype),
+        "b": jnp.zeros((classes,), dtype),
+    }
+
+
+def logreg_logits(params: Params, x) -> jax.Array:
+    return x @ params["w"] + params["b"]
+
+
+def _softmax_xent(logits, labels) -> jax.Array:
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()
+
+
+def logreg_loss(params: Params, x, y) -> jax.Array:
+    return _softmax_xent(logreg_logits(params, x), y)
+
+
+def init_mlp(rng, sizes: Sequence[int], dtype=jnp.float32) -> Params:
+    """MLP with ``len(sizes)-1`` dense layers, GELU between."""
+    layers = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, (d_in, d_out) in zip(keys, zip(sizes[:-1], sizes[1:])):
+        scale = (2.0 / d_in) ** 0.5
+        layers.append(
+            {
+                "w": (jax.random.normal(k, (d_in, d_out)) * scale).astype(dtype),
+                "b": jnp.zeros((d_out,), dtype),
+            }
+        )
+    return {"layers": layers}
+
+
+def mlp_apply(params: Params, x) -> jax.Array:
+    *hidden, last = params["layers"]
+    for layer in hidden:
+        x = jax.nn.gelu(x @ layer["w"] + layer["b"])
+    return x @ last["w"] + last["b"]
+
+
+def mlp_loss(params: Params, x, y) -> jax.Array:
+    return _softmax_xent(mlp_apply(params, x), y)

@@ -1,0 +1,82 @@
+"""Jitted federated aggregation ops.
+
+The reference expresses aggregation as plain user Python (the README
+``aggregate`` at ``README.md:83-86``, weight averaging in
+``fed/tests/test_fed_get.py:66-83``). Here aggregation is a first-class,
+jit-compiled tree op so FedAvg-style reductions fuse into single XLA
+programs on the party mesh (MXU-friendly: one fused elementwise pass over
+each leaf, no Python loop per tensor).
+
+Determinism note (SURVEY.md §7 "bitwise-identical aggregates"): summation
+order over parties is fixed by argument order — a left-to-right fold — and
+accumulation happens in ``acc_dtype`` (default float32), so the same inputs
+produce bitwise-identical outputs on every party and transport.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _fold_sum(leaves: Sequence[Any], acc_dtype):
+    acc = leaves[0].astype(acc_dtype) if acc_dtype else leaves[0]
+    for x in leaves[1:]:
+        acc = acc + (x.astype(acc_dtype) if acc_dtype else x)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("acc_dtype",))
+def _tree_sum(trees, acc_dtype: Optional[str] = "float32"):
+    dtype = jnp.dtype(acc_dtype) if acc_dtype else None
+    return jax.tree_util.tree_map(
+        lambda *xs: _fold_sum(xs, dtype).astype(xs[0].dtype), *trees
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("acc_dtype",))
+def _tree_mean(trees, acc_dtype: Optional[str] = "float32"):
+    n = len(trees)
+    dtype = jnp.dtype(acc_dtype) if acc_dtype else None
+    return jax.tree_util.tree_map(
+        lambda *xs: (_fold_sum(xs, dtype) / n).astype(xs[0].dtype), *trees
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("acc_dtype",))
+def _tree_weighted_mean(trees, weights, acc_dtype: Optional[str] = "float32"):
+    dtype = jnp.dtype(acc_dtype) if acc_dtype else None
+    total = _fold_sum([jnp.asarray(w) for w in weights], dtype)
+
+    def leaf(*xs):
+        acc = xs[0] * weights[0] if dtype is None else xs[0].astype(dtype) * weights[0]
+        for x, w in zip(xs[1:], weights[1:]):
+            acc = acc + (x.astype(dtype) if dtype else x) * w
+        return (acc / total).astype(xs[0].dtype)
+
+    return jax.tree_util.tree_map(leaf, *trees)
+
+
+def tree_sum(*trees, acc_dtype: Optional[str] = "float32"):
+    """Elementwise sum of N identically-shaped pytrees (FedSum)."""
+    if len(trees) == 1:
+        return trees[0]
+    return _tree_sum(tuple(trees), acc_dtype=acc_dtype)
+
+
+def tree_mean(*trees, acc_dtype: Optional[str] = "float32"):
+    """Elementwise mean of N identically-shaped pytrees (FedAvg)."""
+    if len(trees) == 1:
+        return trees[0]
+    return _tree_mean(tuple(trees), acc_dtype=acc_dtype)
+
+
+def tree_weighted_mean(trees, weights, acc_dtype: Optional[str] = "float32"):
+    """Sample-count-weighted FedAvg: sum_i w_i * tree_i / sum_i w_i."""
+    assert len(trees) == len(weights) and trees
+    if len(trees) == 1:
+        return trees[0]
+    return _tree_weighted_mean(tuple(trees), tuple(weights), acc_dtype=acc_dtype)

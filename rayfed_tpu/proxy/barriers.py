@@ -1,0 +1,188 @@
+"""Module-level send/recv barrier layer over the pluggable proxies.
+
+Capability parity: reference ``fed/proxy/barriers.py`` — the L2 layer that
+(a) owns the per-party singleton sender/receiver proxies (there: named Ray
+actors, here: thread-owned transport objects), (b) exposes module-level
+``send``/``recv`` used by the dispatch layer, (c) implements the
+``ping_others`` readiness barrier (ref ``barriers.py:497-523``), and (d)
+routes every data send's completion future into the cleanup drain queue
+(ref ``barriers.py:462-488``; error sends go to the error queue,
+``barriers.py:467-474``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Type
+
+from rayfed_tpu._private.global_context import get_global_context
+from rayfed_tpu.exceptions import FedRemoteError
+from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
+
+logger = logging.getLogger(__name__)
+
+_sender_proxy: Optional[SenderProxy] = None
+_receiver_proxy: Optional[ReceiverProxy] = None
+
+
+def sender_proxy() -> Optional[SenderProxy]:
+    return _sender_proxy
+
+
+def receiver_proxy() -> Optional[ReceiverProxy]:
+    return _receiver_proxy
+
+
+def _default_transport_classes(transport: str):
+    if transport in ("tcp", "tpu"):
+        # 'tpu' layers device placement on arrival on top of the TCP wire;
+        # resolved lazily to keep jax out of control-plane-only processes.
+        if transport == "tpu":
+            from rayfed_tpu.proxy.tpu.tpu_proxy import (
+                TpuReceiverProxy,
+                TpuSenderProxy,
+            )
+
+            return TpuSenderProxy, TpuReceiverProxy
+        from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
+
+        return TcpSenderProxy, TcpReceiverProxy
+    if transport == "grpc":
+        from rayfed_tpu.proxy.grpc.grpc_proxy import (
+            GrpcReceiverProxy,
+            GrpcSenderProxy,
+        )
+
+        return GrpcSenderProxy, GrpcReceiverProxy
+    raise ValueError(f"unknown transport {transport!r}; use 'tcp', 'tpu' or 'grpc'")
+
+
+def start_receiver_proxy(
+    addresses: Dict[str, str],
+    party: str,
+    job_name: str,
+    tls_config: Optional[Dict],
+    proxy_cls: Type[ReceiverProxy],
+    proxy_config: Optional[Dict] = None,
+    ready_timeout_s: float = 60,
+) -> None:
+    """Start + readiness-check the receiver (ref ``barriers.py:248-281``:
+    init blocks until the server bound its port, and a bind failure is an
+    AssertionError — pinned by ``fed/tests/test_listening_address.py``)."""
+    global _receiver_proxy
+    _receiver_proxy = proxy_cls(
+        addresses[party], party, job_name, tls_config, proxy_config
+    )
+    _receiver_proxy.start()
+    ok, err = _receiver_proxy.is_ready(timeout=ready_timeout_s)
+    assert ok, err
+    logger.info("Receiver proxy ready on %s.", addresses[party])
+
+
+def start_sender_proxy(
+    addresses: Dict[str, str],
+    party: str,
+    job_name: str,
+    tls_config: Optional[Dict],
+    proxy_cls: Type[SenderProxy],
+    proxy_config: Optional[Dict] = None,
+) -> None:
+    global _sender_proxy
+    _sender_proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
+    _sender_proxy.start()
+    logger.info("Sender proxy started.")
+
+
+def stop_proxies() -> None:
+    global _sender_proxy, _receiver_proxy
+    if _sender_proxy is not None:
+        _sender_proxy.stop()
+        _sender_proxy = None
+    if _receiver_proxy is not None:
+        _receiver_proxy.stop()
+        _receiver_proxy = None
+
+
+def send(
+    dest_party: str,
+    data,
+    upstream_seq_id,
+    downstream_seq_id,
+    is_error: bool = False,
+) -> Future:
+    """Fire-and-forget push; completion future is drained asynchronously by
+    the cleanup manager (ref ``barriers.py:462-488``)."""
+    assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
+    fut = _sender_proxy.send(
+        dest_party, data, upstream_seq_id, downstream_seq_id, is_error=is_error
+    )
+    ctx = get_global_context()
+    if ctx is not None:
+        ctx.get_cleanup_manager().push_to_sending(
+            fut, dest_party, upstream_seq_id, downstream_seq_id, is_error
+        )
+    return fut
+
+
+def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
+    """Future for data addressed to (upstream_seq_id, curr_seq_id). If the
+    payload is a FedRemoteError envelope, the future raises it and the error
+    is recorded on the context (ref ``barriers.py:222-234``)."""
+    assert _receiver_proxy is not None, "receiver proxy not started; call fed.init()"
+    raw = _receiver_proxy.get_data(src_party, upstream_seq_id, curr_seq_id)
+    out: Future = Future()
+
+    def _chain(f: Future) -> None:
+        try:
+            value = f.result()
+        except BaseException as e:  # noqa: BLE001
+            out.set_exception(e)
+            return
+        if isinstance(value, FedRemoteError):
+            logger.debug(
+                "Receiving exception from %s: %s; raising to consumer.",
+                src_party, value,
+            )
+            ctx = get_global_context()
+            if ctx is not None:
+                ctx.set_last_received_error(value)
+            out.set_exception(value)
+        else:
+            out.set_result(value)
+
+    raw.add_done_callback(_chain)
+    return out
+
+
+def ping_others(
+    addresses: Dict[str, str],
+    self_party: str,
+    max_retries: int = 3600,
+    interval_s: float = 2.0,
+) -> bool:
+    """Block until every other party's receiver answers a ping
+    (ref ``barriers.py:497-523``: up to 3600 attempts, 2s apart)."""
+    assert _sender_proxy is not None
+    others = {p for p in addresses if p != self_party}
+    reached: set = set()
+    for _ in range(max_retries):
+        for p in sorted(others - reached):
+            try:
+                fut = _sender_proxy.send(p, "ping", "ping", "ping")
+                if fut.result(timeout=interval_s * 5):
+                    reached.add(p)
+            except Exception:  # noqa: BLE001 - retried until exhausted
+                pass
+        if reached == others:
+            logger.info("All parties are ready.")
+            return True
+        logger.info(
+            "Waiting for parties %s to be ready...", sorted(others - reached)
+        )
+        time.sleep(interval_s)
+    raise RuntimeError(
+        f"Failed to wait for parties {sorted(others - reached)} to be ready "
+        f"after {max_retries} attempts."
+    )

@@ -1,0 +1,92 @@
+"""Abstract transport interfaces.
+
+Capability parity: reference ``fed/proxy/base_proxy.py:21-106`` — the
+pluggable seam that lets ``fed.init(sender_proxy_cls=..., receiver_proxy_cls
+=...)`` swap transports (ref ``fed/api.py:73-75,239-292``). Our proxies are
+thread-owned objects in the party process (the reference wraps them in
+singleton Ray actors, ``fed/proxy/barriers.py:113-240``); the contract is
+future-based rather than coroutine-based so callers never touch the
+transport's event loop.
+"""
+
+from __future__ import annotations
+
+import abc
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+
+class SenderProxy(abc.ABC):
+    def __init__(
+        self,
+        addresses: Dict[str, str],
+        party: str,
+        job_name: str,
+        tls_config: Optional[Dict],
+        proxy_config: Optional[Dict] = None,
+    ) -> None:
+        self._addresses = addresses
+        self._party = party
+        self._job_name = job_name
+        self._tls_config = tls_config or {}
+        self._proxy_config = proxy_config or {}
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Spin up whatever background machinery sending needs."""
+
+    @abc.abstractmethod
+    def send(
+        self,
+        dest_party: str,
+        data,
+        upstream_seq_id,
+        downstream_seq_id,
+        is_error: bool = False,
+    ) -> Future:
+        """Push ``data`` (a value or a value Future) to ``dest_party`` under
+        the (upstream, downstream) rendezvous key. The returned Future
+        resolves True once the peer acknowledged, or raises."""
+
+    def get_stats(self) -> Dict:
+        return {}
+
+    def stop(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+class ReceiverProxy(abc.ABC):
+    def __init__(
+        self,
+        listen_addr: str,
+        party: str,
+        job_name: str,
+        tls_config: Optional[Dict],
+        proxy_config: Optional[Dict] = None,
+    ) -> None:
+        self._listen_addr = listen_addr
+        self._party = party
+        self._job_name = job_name
+        self._tls_config = tls_config or {}
+        self._proxy_config = proxy_config or {}
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Bind and serve. Must make :meth:`is_ready` answerable."""
+
+    @abc.abstractmethod
+    def is_ready(self, timeout: Optional[float] = None):
+        """Return (ok, error_message_or_None) — reference
+        ``barriers.py:277-280`` blocks init on this."""
+
+    @abc.abstractmethod
+    def get_data(self, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
+        """Future for the payload addressed (upstream_seq_id, curr_seq_id).
+        Resolves whenever the data arrives — before or after this call
+        (either-side-first rendezvous, ref ``grpc_proxy.py:276-283,332-340``)."""
+
+    def get_stats(self) -> Dict:
+        return {}
+
+    def stop(self) -> None:  # pragma: no cover - trivial default
+        pass

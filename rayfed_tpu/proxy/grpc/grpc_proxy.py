@@ -1,0 +1,255 @@
+"""Reference-parity gRPC transport.
+
+This is the measurement-baseline lane (SURVEY.md §7 stage 2): it reproduces
+the reference's wire behavior — one unary RPC per object with the payload
+**cloudpickled** inside the request (ref ``fed/proxy/grpc/grpc_proxy.py:
+193-220``), gRPC channel-level retry policy (ref ``grpc_options.py:19-46``),
+500 MB default message caps, job-name 417 isolation, and mutual TLS — so
+``bench.py`` can compare the native TCP/TPU data plane against exactly what
+the reference does.
+
+Implementation note: rather than generated protobuf stubs, the single
+``SendData`` method uses raw-bytes (de)serializers with a msgpack header —
+wire-equivalent framing without codegen. Everything above the channel is the
+reference's shape: sender reuses one channel per destination, receiver
+parks payloads in the shared rendezvous store.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+import grpc
+import msgpack
+
+import cloudpickle
+from rayfed_tpu._private.constants import CODE_OK
+from rayfed_tpu._private.serialization import restricted_loads
+from rayfed_tpu.config import TcpCrossSiloMessageConfig
+from rayfed_tpu.exceptions import FedLocalError
+from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
+from rayfed_tpu.proxy.rendezvous import RendezvousStore
+
+logger = logging.getLogger(__name__)
+
+_SERVICE = "rayfed_tpu.GrpcService"
+_SEND_DATA = "SendData"
+_METHOD_PATH = f"/{_SERVICE}/{_SEND_DATA}"
+
+_DEFAULT_MAX_MSG = 500 * 1024 * 1024  # parity: grpc_options.py:28-29
+
+
+def _identity(b: bytes) -> bytes:
+    return b
+
+
+def _channel_options(config: TcpCrossSiloMessageConfig):
+    policy = config.get_retry_policy()
+    max_msg = config.messages_max_size_in_bytes or _DEFAULT_MAX_MSG
+    retry = {
+        "maxAttempts": policy.max_attempts,
+        "initialBackoff": f"{policy.initial_backoff_ms / 1000}s",
+        "maxBackoff": f"{policy.max_backoff_ms / 1000}s",
+        "backoffMultiplier": policy.backoff_multiplier,
+        "retryableStatusCodes": ["UNAVAILABLE"],
+    }
+    return [
+        ("grpc.max_send_message_length", max_msg),
+        ("grpc.max_receive_message_length", max_msg),
+        ("grpc.enable_retries", 1),
+        ("grpc.so_reuseport", 0),
+        (
+            "grpc.service_config",
+            json.dumps(
+                {
+                    "methodConfig": [
+                        {"name": [{"service": _SERVICE}], "retryPolicy": retry}
+                    ]
+                }
+            ),
+        ),
+    ]
+
+
+def _load_tls_files(tls_config: Dict):
+    with open(tls_config["ca_cert"], "rb") as f:
+        ca = f.read()
+    with open(tls_config["cert"], "rb") as f:
+        cert = f.read()
+    with open(tls_config["key"], "rb") as f:
+        key = f.read()
+    return ca, cert, key
+
+
+def _pack_request(job_name, src_party, upstream_seq_id, downstream_seq_id,
+                  is_error, payload: bytes) -> bytes:
+    header = {
+        "job": job_name,
+        "src": src_party,
+        "up": str(upstream_seq_id),
+        "down": str(downstream_seq_id),
+        "is_error": bool(is_error),
+        "pkind": "pickle",
+        "pmeta": b"",
+    }
+    return msgpack.packb({"h": header, "d": payload}, use_bin_type=True)
+
+
+class GrpcSenderProxy(SenderProxy):
+    def __init__(self, addresses, party, job_name, tls_config, proxy_config=None):
+        super().__init__(addresses, party, job_name, tls_config, proxy_config)
+        self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
+        self._channels: Dict[str, grpc.Channel] = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="fedtpu-grpc-send"
+        )
+        self._stats = {"send_op_count": 0}
+
+    def start(self) -> None:
+        pass
+
+    def get_stats(self) -> Dict:
+        return dict(self._stats)
+
+    def stop(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+        self._pool.shutdown(wait=False)
+
+    def _get_channel(self, dest_party: str) -> grpc.Channel:
+        # One reused channel per destination (ref grpc_proxy.py:117,123-141).
+        ch = self._channels.get(dest_party)
+        if ch is None:
+            addr = self._addresses[dest_party]
+            options = _channel_options(self._config)
+            if self._tls_config:
+                ca, cert, key = _load_tls_files(self._tls_config)
+                creds = grpc.ssl_channel_credentials(
+                    root_certificates=ca, private_key=key, certificate_chain=cert
+                )
+                ch = grpc.secure_channel(addr, creds, options=options)
+            else:
+                ch = grpc.insecure_channel(addr, options=options)
+            self._channels[dest_party] = ch
+        return ch
+
+    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
+             is_error: bool = False) -> Future:
+        return self._pool.submit(
+            self._send_sync, dest_party, data, upstream_seq_id,
+            downstream_seq_id, is_error,
+        )
+
+    def _send_sync(self, dest_party, data, upstream_seq_id, downstream_seq_id,
+                   is_error: bool) -> bool:
+        if isinstance(data, Future):
+            try:
+                data = data.result()
+            except BaseException as e:  # noqa: BLE001
+                raise FedLocalError(e) from None
+        # Parity hot path: cloudpickle the whole payload (ref
+        # grpc_proxy.py:202) — this is exactly the cost the native
+        # transports avoid.
+        blob = cloudpickle.dumps(data)
+        request = _pack_request(
+            self._job_name, self._party, upstream_seq_id, downstream_seq_id,
+            is_error, blob,
+        )
+        stub = self._get_channel(dest_party).unary_unary(
+            _METHOD_PATH,
+            request_serializer=_identity,
+            response_deserializer=_identity,
+        )
+        resp_bytes = stub(request, timeout=self._config.timeout_in_ms / 1000)
+        resp = msgpack.unpackb(resp_bytes, raw=False)
+        self._stats["send_op_count"] += 1
+        if resp["code"] == CODE_OK:
+            return True
+        logger.warning(
+            "peer rejected send: code=%s message=%s", resp["code"], resp["msg"]
+        )
+        raise RuntimeError(f"send rejected: code={resp['code']} {resp['msg']}")
+
+
+class GrpcReceiverProxy(ReceiverProxy):
+    def __init__(self, listen_addr, party, job_name, tls_config, proxy_config=None):
+        super().__init__(listen_addr, party, job_name, tls_config, proxy_config)
+        self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
+        allowed = self._config.serializing_allowed_list
+
+        def decode(header, payload):
+            return restricted_loads(bytes(payload), allowed)
+
+        self._store = RendezvousStore(
+            job_name, decode,
+            max_payload_bytes=self._config.messages_max_size_in_bytes,
+        )
+        self._server: Optional[grpc.Server] = None
+        self._ready_result = None
+
+    def start(self) -> None:
+        store = self._store
+
+        def handle_send_data(request: bytes, context) -> bytes:
+            msg = msgpack.unpackb(request, raw=False)
+            code, text = store.offer(msg["h"], memoryview(msg["d"]))
+            return msgpack.packb({"code": code, "msg": text}, use_bin_type=True)
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                _SEND_DATA: grpc.unary_unary_rpc_method_handler(
+                    handle_send_data,
+                    request_deserializer=_identity,
+                    response_serializer=_identity,
+                )
+            },
+        )
+        max_msg = self._config.messages_max_size_in_bytes or _DEFAULT_MAX_MSG
+        self._server = grpc.server(
+            ThreadPoolExecutor(max_workers=8, thread_name_prefix="fedtpu-grpc-recv"),
+            options=[
+                ("grpc.max_send_message_length", max_msg),
+                ("grpc.max_receive_message_length", max_msg),
+                ("grpc.so_reuseport", 0),
+            ],
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        try:
+            if self._tls_config:
+                ca, cert, key = _load_tls_files(self._tls_config)
+                creds = grpc.ssl_server_credentials(
+                    [(key, cert)], root_certificates=ca,
+                    require_client_auth=True,
+                )
+                bound = self._server.add_secure_port(self._listen_addr, creds)
+            else:
+                bound = self._server.add_insecure_port(self._listen_addr)
+            if bound == 0:
+                self._ready_result = (
+                    False, f"failed to bind {self._listen_addr}"
+                )
+                return
+            self._server.start()
+            self._ready_result = (True, None)
+        except Exception as e:  # noqa: BLE001 - surfaced via is_ready
+            self._ready_result = (False, f"failed to start: {e}")
+
+    def is_ready(self, timeout: Optional[float] = None):
+        return self._ready_result
+
+    def get_data(self, src_party, upstream_seq_id, curr_seq_id) -> Future:
+        return self._store.take(upstream_seq_id, curr_seq_id)
+
+    def get_stats(self) -> Dict:
+        return self._store.get_stats()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+            self._server = None
+        self._store.shutdown()

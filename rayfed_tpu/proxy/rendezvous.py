@@ -1,0 +1,121 @@
+"""Transport-independent (upstream_seq_id, downstream_seq_id) rendezvous.
+
+The core receiver-side data structure shared by every transport backend
+(TCP, gRPC, TPU): data may arrive before or after the consumer asks for it,
+and whichever side is first parks the state the other completes — the
+event-either-side-first pattern of the reference
+(``fed/proxy/grpc/grpc_proxy.py:276-283,332-340``), generalized so that the
+decode step (and, for the TPU backend, device placement) runs on a worker
+pool off the transport's event loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Tuple
+
+from rayfed_tpu._private import serialization
+from rayfed_tpu._private.constants import (
+    CODE_INTERNAL_ERROR,
+    CODE_JOB_MISMATCH,
+    CODE_OK,
+)
+
+logger = logging.getLogger(__name__)
+
+# decode_fn(header, payload) -> value
+DecodeFn = Callable[[Dict, memoryview], object]
+
+
+def default_decode(allowed_list):
+    def decode(header: Dict, payload) -> object:
+        return serialization.decode_payload(
+            header["pkind"], header.get("pmeta", b""), payload, allowed_list
+        )
+
+    return decode
+
+
+class RendezvousStore:
+    def __init__(
+        self,
+        job_name: str,
+        decode_fn: DecodeFn,
+        max_payload_bytes: Optional[int] = None,
+        decode_workers: int = 2,
+    ) -> None:
+        self._job_name = job_name
+        self._decode_fn = decode_fn
+        self._max_payload_bytes = max_payload_bytes
+        self._lock = threading.Lock()
+        self._arrived: Dict[Tuple[str, str], Tuple[Dict, memoryview]] = {}
+        self._waiters: Dict[Tuple[str, str], Future] = {}
+        self._pool = ThreadPoolExecutor(
+            max_workers=decode_workers, thread_name_prefix="fedtpu-recv-decode"
+        )
+        self._stats = {"receive_op_count": 0}
+
+    # -- transport side ----------------------------------------------------
+
+    def offer(self, header: Dict, payload) -> Tuple[int, str]:
+        """Accept one DATA frame; returns (code, message) for the response.
+        Must not block on decode — decoding runs on the worker pool."""
+        job = header.get("job")
+        if job != self._job_name:
+            # Job-name isolation (ref grpc_proxy.py:311-320).
+            logger.warning(
+                "rejecting data for job %r (this receiver serves %r)",
+                job, self._job_name,
+            )
+            return (
+                CODE_JOB_MISMATCH,
+                f"job name mismatch: got {job!r}, expected {self._job_name!r}",
+            )
+        nbytes = memoryview(payload).nbytes if payload is not None else 0
+        if self._max_payload_bytes is not None and nbytes > self._max_payload_bytes:
+            return (
+                CODE_INTERNAL_ERROR,
+                f"payload {nbytes} bytes exceeds limit {self._max_payload_bytes}",
+            )
+        key = (header["up"], header["down"])
+        with self._lock:
+            self._stats["receive_op_count"] += 1
+            waiter = self._waiters.pop(key, None)
+            if waiter is None:
+                # An error envelope substituting already-arrived data
+                # overwrites the slot (sender reuses the same seq ids).
+                self._arrived[key] = (header, payload)
+        if waiter is not None:
+            self._pool.submit(self._decode_into, header, payload, waiter)
+        return CODE_OK, "ok"
+
+    # -- consumer side -----------------------------------------------------
+
+    def take(self, upstream_seq_id, curr_seq_id) -> Future:
+        key = (str(upstream_seq_id), str(curr_seq_id))
+        out: Future = Future()
+        with self._lock:
+            if key in self._arrived:
+                header, payload = self._arrived.pop(key)
+            else:
+                self._waiters[key] = out
+                return out
+        self._pool.submit(self._decode_into, header, payload, out)
+        return out
+
+    def _decode_into(self, header: Dict, payload, out: Future) -> None:
+        try:
+            value = self._decode_fn(header, payload)
+        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+            out.set_exception(e)
+            return
+        out.set_result(value)
+
+    def get_stats(self) -> Dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,164 @@
+"""FTP1: the native binary wire protocol of the TCP data plane.
+
+Replaces the reference's single-RPC gRPC service
+(``fed/grpc/fed.proto:5-19``: SendDataRequest{data, upstream_seq_id,
+downstream_seq_id, job_name} -> SendDataResponse{code, result}) with a
+length-prefixed binary framing that (a) carries the payload *outside* any
+serialization envelope so array bytes are written straight from device
+buffers, and (b) needs no protobuf codegen.
+
+Frame layout (big-endian):
+
+    magic   4s   b"FTP1"
+    version u8
+    ftype   u8   0 = DATA, 1 = RESP
+    hlen    u32  msgpack header length
+    plen    u64  payload length (0 for RESP)
+    header  msgpack dict
+    payload raw bytes
+
+DATA header: {job, src, up, down, is_error, pkind, pmeta}
+RESP header: {code, msg}   codes per reference: 200 OK, 417 job mismatch,
+500 internal (ref ``grpc_proxy.py:311-342``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from rayfed_tpu._private.constants import WIRE_MAGIC, WIRE_VERSION
+
+_PREFIX = struct.Struct(">4sBBIQ")
+PREFIX_LEN = _PREFIX.size
+
+FTYPE_DATA = 0
+FTYPE_RESP = 1
+
+# Hard sanity cap on a single frame payload (1 TiB) — real limits come from
+# config (messages_max_size_in_bytes).
+_MAX_PAYLOAD = 1 << 40
+# Headers are tiny msgpack dicts; anything near this is an attack or a bug.
+_MAX_HEADER = 64 * 1024 * 1024
+# Response frames carry only {code, msg}.
+MAX_RESP_FRAME = 1 << 20
+
+_READ_CHUNK = 8 * 1024 * 1024
+
+
+class WireError(Exception):
+    pass
+
+
+def encode_prefix_and_header(ftype: int, header: Dict, payload_len: int) -> bytes:
+    hdr = msgpack.packb(header, use_bin_type=True)
+    return _PREFIX.pack(WIRE_MAGIC, WIRE_VERSION, ftype, len(hdr), payload_len) + hdr
+
+
+def as_byte_view(buf) -> memoryview:
+    view = memoryview(buf)
+    if view.nbytes == 0:
+        return memoryview(b"")
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    return view
+
+
+async def write_frame(
+    writer: asyncio.StreamWriter,
+    ftype: int,
+    header: Dict,
+    buffers: Optional[List] = None,
+    chunk_bytes: int = 4 * 1024 * 1024,
+) -> None:
+    buffers = buffers or []
+    payload_len = sum(memoryview(b).nbytes for b in buffers)
+    writer.write(encode_prefix_and_header(ftype, header, payload_len))
+    for buf in buffers:
+        view = as_byte_view(buf)
+        # Chunked writes with periodic drain keep memory bounded on 100MB+
+        # pushes instead of buffering the whole payload in the transport.
+        for off in range(0, len(view), chunk_bytes):
+            writer.write(view[off: off + chunk_bytes])
+            await writer.drain()
+    await writer.drain()
+
+
+async def read_frame(
+    reader: asyncio.StreamReader,
+    max_payload: Optional[int] = None,
+) -> Tuple[int, Dict, memoryview]:
+    """Read one frame. Size limits are enforced *before* the payload is
+    buffered, so an oversized frame costs no memory — the connection is torn
+    down instead of answered (memory protection beats politeness; the
+    reference gets the same effect from gRPC's max_receive_message_length).
+
+    The payload lands in a fresh ``bytearray``, so array views decoded from
+    it (``np.frombuffer``) are writable — consumers may mutate in place.
+    """
+    prefix = await reader.readexactly(PREFIX_LEN)
+    magic, version, ftype, hlen, plen = _PREFIX.unpack(prefix)
+    if magic != WIRE_MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise WireError(f"unsupported wire version {version}")
+    if hlen > _MAX_HEADER:
+        raise WireError(f"header length {hlen} exceeds cap {_MAX_HEADER}")
+    cap = _MAX_PAYLOAD if max_payload is None else min(max_payload, _MAX_PAYLOAD)
+    if plen > cap:
+        raise WireError(f"payload length {plen} exceeds cap {cap}")
+    header = msgpack.unpackb(await reader.readexactly(hlen), raw=False)
+    if not plen:
+        return ftype, header, memoryview(b"")
+    buf = bytearray(plen)
+    view = memoryview(buf)
+    off = 0
+    while off < plen:
+        chunk = await reader.read(min(plen - off, _READ_CHUNK))
+        if not chunk:
+            raise asyncio.IncompleteReadError(bytes(view[:off]), plen)
+        view[off: off + len(chunk)] = chunk
+        off += len(chunk)
+    return ftype, header, view
+
+
+# ---------------------------------------------------------------------------
+# TLS (mutual) — parity with ref ``fed/utils.py:149-163`` +
+# ``grpc_proxy.py:124-141,362-372``: both sides present certs signed by the
+# shared CA; ICI is physically private, TLS protects the DCN/TCP control+data
+# plane (SURVEY.md C16).
+# ---------------------------------------------------------------------------
+
+
+def tls_enabled(tls_config: Optional[Dict]) -> bool:
+    return bool(tls_config)
+
+
+def _check_tls_config(tls_config: Dict) -> None:
+    missing = {"ca_cert", "cert", "key"} - set(tls_config)
+    if missing:
+        raise ValueError(f"tls_config missing keys: {sorted(missing)}")
+
+
+def make_server_ssl_context(tls_config: Dict) -> ssl.SSLContext:
+    _check_tls_config(tls_config)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile=tls_config["cert"], keyfile=tls_config["key"])
+    ctx.load_verify_locations(cafile=tls_config["ca_cert"])
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def make_client_ssl_context(tls_config: Dict) -> ssl.SSLContext:
+    _check_tls_config(tls_config)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(certfile=tls_config["cert"], keyfile=tls_config["key"])
+    ctx.load_verify_locations(cafile=tls_config["ca_cert"])
+    # Party certs are CA-signed per party name, not per hostname.
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

@@ -1,0 +1,119 @@
+"""Minimal pytree flatten/unflatten for the core engine.
+
+Capability parity with the reference's vendored pytree
+(``fed/tree_util.py:180-231``): the dispatch layer must find ``FedObject``
+leaves nested inside dict/list/tuple/namedtuple/OrderedDict argument
+structures. We keep this dependency-free on purpose — the core engine must
+import without JAX so that control-plane-only party processes stay light;
+array-carrying code paths use ``jax.tree_util`` directly (SURVEY.md C7).
+
+This is an original implementation: a single recursive flatten that records
+a spec tree, rather than the reference's registry of per-type
+flatten/unflatten pairs.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, List, Tuple
+
+__all__ = ["tree_flatten", "tree_unflatten", "tree_map", "TreeSpec"]
+
+_LEAF = "leaf"
+
+
+class TreeSpec:
+    """Structure descriptor produced by :func:`tree_flatten`.
+
+    ``kind`` is one of ``leaf``, ``list``, ``tuple``, ``namedtuple``,
+    ``dict``, ``odict``; ``meta`` holds keys (dicts) or the namedtuple type;
+    ``children`` the child specs in flatten order.
+    """
+
+    __slots__ = ("kind", "meta", "children")
+
+    def __init__(self, kind: str, meta: Any = None, children: Tuple["TreeSpec", ...] = ()):
+        self.kind = kind
+        self.meta = meta
+        self.children = children
+
+    @property
+    def num_leaves(self) -> int:
+        if self.kind == _LEAF:
+            return 1
+        return sum(c.num_leaves for c in self.children)
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, TreeSpec)
+            and self.kind == other.kind
+            and self.meta == other.meta
+            and self.children == other.children
+        )
+
+    def __repr__(self) -> str:
+        if self.kind == _LEAF:
+            return "*"
+        return f"{self.kind}{list(self.children)!r}"
+
+
+def _is_namedtuple(obj: Any) -> bool:
+    return isinstance(obj, tuple) and hasattr(obj, "_fields") and hasattr(obj, "_make")
+
+
+def tree_flatten(tree: Any) -> Tuple[List[Any], TreeSpec]:
+    """Flatten ``tree`` into (leaves, spec). Containers recognized: list,
+    tuple, namedtuple, dict, OrderedDict. Everything else is a leaf."""
+    leaves: List[Any] = []
+
+    def go(node: Any) -> TreeSpec:
+        if _is_namedtuple(node):
+            return TreeSpec("namedtuple", type(node), tuple(go(c) for c in node))
+        if isinstance(node, OrderedDict):
+            return TreeSpec("odict", list(node.keys()), tuple(go(node[k]) for k in node))
+        if isinstance(node, dict):
+            keys = list(node.keys())
+            return TreeSpec("dict", keys, tuple(go(node[k]) for k in keys))
+        if isinstance(node, list):
+            return TreeSpec("list", None, tuple(go(c) for c in node))
+        if isinstance(node, tuple):
+            return TreeSpec("tuple", None, tuple(go(c) for c in node))
+        leaves.append(node)
+        return TreeSpec(_LEAF)
+
+    spec = go(tree)
+    return leaves, spec
+
+
+def tree_unflatten(leaves: List[Any], spec: TreeSpec) -> Any:
+    """Inverse of :func:`tree_flatten`. Consumes ``leaves`` in order."""
+    it = iter(leaves)
+
+    def go(s: TreeSpec) -> Any:
+        if s.kind == _LEAF:
+            return next(it)
+        children = [go(c) for c in s.children]
+        if s.kind == "list":
+            return children
+        if s.kind == "tuple":
+            return tuple(children)
+        if s.kind == "namedtuple":
+            return s.meta(*children)
+        if s.kind == "dict":
+            return dict(zip(s.meta, children))
+        if s.kind == "odict":
+            return OrderedDict(zip(s.meta, children))
+        raise ValueError(f"unknown tree spec kind: {s.kind}")
+
+    out = go(spec)
+    # Detect leaf-count mismatch (same contract as jax.tree_util).
+    try:
+        next(it)
+    except StopIteration:
+        return out
+    raise ValueError("too many leaves for tree spec")
+
+
+def tree_map(fn: Callable[[Any], Any], tree: Any) -> Any:
+    leaves, spec = tree_flatten(tree)
+    return tree_unflatten([fn(x) for x in leaves], spec)

@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Mirrors the reference's test recipe (SURVEY.md §4): multi-party tests spawn
+one process per party talking over localhost; JAX work runs on a simulated
+8-device CPU platform (``--xla_force_host_platform_device_count=8``) so
+sharding/mesh code paths are exercised without TPU hardware.
+
+This environment force-registers a TPU PJRT plugin from sitecustomize when
+``PALLAS_AXON_POOL_IPS`` is set, overriding ``JAX_PLATFORMS``; tests must
+(a) drop that var so *spawned party processes* come up CPU-only, and
+(b) force ``jax_platforms=cpu`` via config for the already-started pytest
+process itself.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

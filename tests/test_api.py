@@ -1,0 +1,105 @@
+"""Single-party API tests (mirror of ref ``fed/tests/test_api.py`` and
+``test_reset_context.py`` / ``test_repeat_init.py``: init asserts, config
+plumbing, deterministic seq-id restart across init/shutdown cycles)."""
+
+import pytest
+
+from tests.utils import MP, get_addresses, run_parties
+
+
+def run_init_asserts(party, addresses):
+    import rayfed_tpu as fed
+
+    with pytest.raises(AssertionError):
+        fed.init(addresses=None, party="alice")
+    with pytest.raises(AssertionError):
+        fed.init(addresses=addresses, party=None)
+    # Party must be a key of addresses (ref test_api.py missing-party case).
+    with pytest.raises(AssertionError):
+        fed.init(addresses=addresses, party="nonexistent")
+    with pytest.raises(ValueError):
+        fed.init(addresses={"alice": "bad_address"}, party="alice")
+
+    fed.init(addresses=addresses, party=party)
+    import rayfed_tpu.config as fed_config
+    from rayfed_tpu._private.global_context import get_global_context
+
+    cfg = fed_config.get_cluster_config(get_global_context().get_job_name())
+    assert cfg.cluster_addresses == addresses
+    assert cfg.current_party == party
+    fed.shutdown()
+
+
+def run_repeat_init(party, addresses):
+    import rayfed_tpu as fed
+    from rayfed_tpu._private.global_context import get_global_context
+
+    observed_ids = []
+    for _ in range(3):
+        fed.init(addresses=addresses, party=party)
+
+        @fed.remote
+        def f(x):
+            return x + 1
+
+        obj = f.party(party).remote(1)
+        observed_ids.append(obj.get_fed_task_id())
+        assert fed.get(obj) == 2
+        assert get_global_context() is not None
+        fed.shutdown()
+        assert get_global_context() is None
+    # Deterministic seq ids must restart identically after shutdown
+    # (ref test_reset_context.py / test_repeat_init.py).
+    assert len(set(observed_ids)) == 1
+
+
+def run_kv_lifecycle(party, addresses):
+    import rayfed_tpu as fed
+    from rayfed_tpu._private import kv
+
+    fed.init(addresses=addresses, party=party, job_name="kvjob")
+    assert kv.kv_initialized()
+    assert kv.wrap_kv_key("kvjob", "k") == "FEDTPU#kvjob#k"
+    kv.kv_put("kvjob", "k", b"v")
+    assert kv.kv_get("kvjob", "k") == b"v"
+    fed.shutdown()
+    # Reset on shutdown (ref test_internal_kv.py).
+    assert not kv.kv_initialized()
+    assert kv.kv_get("kvjob", "k") is None
+
+
+def run_local_pipeline(party, addresses):
+    import numpy as np
+
+    import rayfed_tpu as fed
+
+    fed.init(addresses=addresses, party=party)
+
+    @fed.remote
+    def make(x):
+        return np.full((4,), x, dtype=np.float32)
+
+    @fed.remote
+    def add(a, b):
+        return a + b
+
+    a = make.party(party).remote(1.0)
+    b = make.party(party).remote(2.0)
+    c = add.party(party).remote(a, b)
+    np.testing.assert_array_equal(fed.get(c), np.full((4,), 3.0, np.float32))
+    # num_returns > 1 (ref test_options.py)
+    @fed.remote
+    def pair():
+        return 1, 2
+
+    x, y = pair.party(party).options(num_returns=2).remote()
+    assert fed.get(x) == 1 and fed.get(y) == 2
+    fed.shutdown()
+
+
+@pytest.mark.parametrize(
+    "target",
+    [run_init_asserts, run_repeat_init, run_kv_lifecycle, run_local_pipeline],
+)
+def test_single_party(target):
+    run_parties(target, ["alice"])

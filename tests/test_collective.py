@@ -1,0 +1,89 @@
+"""Aggregation ops + collective lane tests (SURVEY.md §7 stages 4-5:
+bitwise-identical aggregates across lanes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rayfed_tpu import collective
+from rayfed_tpu.ops import aggregate
+
+
+def _trees(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": rng.normal(size=(16, 8)).astype(np.float32),
+            "b": rng.normal(size=(8,)).astype(np.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+def test_tree_sum_and_mean():
+    trees = _trees()
+    s = aggregate.tree_sum(*trees)
+    m = aggregate.tree_mean(*trees)
+    np.testing.assert_allclose(
+        np.asarray(s["w"]), trees[0]["w"] + trees[1]["w"] + trees[2]["w"], rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(m["b"]),
+        (trees[0]["b"] + trees[1]["b"] + trees[2]["b"]) / 3,
+        rtol=1e-6,
+    )
+
+
+def test_tree_mean_deterministic_bitwise():
+    trees = _trees()
+    a = jax.tree_util.tree_map(np.asarray, aggregate.tree_mean(*trees))
+    b = jax.tree_util.tree_map(np.asarray, aggregate.tree_mean(*trees))
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        assert (x == y).all()
+
+
+def test_tree_weighted_mean():
+    trees = _trees(2)
+    out = aggregate.tree_weighted_mean(trees, [1.0, 3.0])
+    expect = (trees[0]["w"] * 1.0 + trees[1]["w"] * 3.0) / 4.0
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, rtol=1e-6)
+
+
+def test_bf16_mean_accumulates_in_f32():
+    import ml_dtypes
+
+    ones = np.full((64,), 1.004, dtype=ml_dtypes.bfloat16)
+    trees = [{"w": ones}] * 4
+    out = aggregate.tree_mean(*trees)
+    assert out["w"].dtype == jnp.bfloat16
+    # f32 accumulation then cast: mean of identical values stays identical.
+    np.testing.assert_array_equal(np.asarray(out["w"]), ones)
+
+
+def test_cross_party_mean_matches_push_lane_bitwise():
+    # 8 CPU devices, 2 parties x 4-device sub-meshes.
+    trees = _trees(2, seed=7)
+    mesh = collective.party_axis_mesh(2)
+    assert mesh.shape == {"party": 2, "data": 4}
+    collective_out = collective.cross_party_mean(trees, mesh)
+    push_out = aggregate.tree_mean(*trees)
+    for x, y in zip(
+        jax.tree_util.tree_leaves(collective_out),
+        jax.tree_util.tree_leaves(push_out),
+    ):
+        # Bitwise equality between the psum lane and the push lane
+        # (BASELINE.json north star: "bitwise-identical aggregates").
+        assert (np.asarray(x) == np.asarray(y)).all()
+
+
+def test_cross_party_sum_four_parties():
+    trees = _trees(4, seed=11)
+    mesh = collective.party_axis_mesh(4)
+    stacked = collective.stack_party_tree(trees, mesh)
+    out = collective.cross_party_reduce(stacked, mesh, op="sum")
+    expect = aggregate.tree_sum(*trees)
+    # Every party slot holds the aggregate.
+    for p in range(4):
+        np.testing.assert_allclose(
+            np.asarray(out["w"][p]), np.asarray(expect["w"]), rtol=1e-6
+        )

@@ -1,0 +1,78 @@
+"""Party-local executor unit tests (our substrate; no reference equivalent —
+the reference delegates to Ray tasks)."""
+
+import time
+
+import pytest
+
+from rayfed_tpu._private.executor import LocalExecutor
+
+
+@pytest.fixture()
+def executor():
+    ex = LocalExecutor(max_workers=4)
+    yield ex
+    ex.shutdown(wait=False)
+
+
+def test_simple_submit(executor):
+    fut = executor.submit(lambda a, b: a + b, (1, 2))
+    assert fut.result(timeout=5) == 3
+
+
+def test_future_args_resolved(executor):
+    a = executor.submit(lambda: 10)
+    b = executor.submit(lambda x: x + 1, (a,))
+    c = executor.submit(lambda t: t["v"] * 2, ({"v": b},))
+    assert c.result(timeout=5) == 22
+
+
+def test_chain_deeper_than_pool(executor):
+    # 10 chained tasks through a 4-worker pool: FIFO + deps-before-consumers
+    # must not deadlock.
+    fut = executor.submit(lambda: 0)
+    for _ in range(10):
+        fut = executor.submit(lambda x: x + 1, (fut,))
+    assert fut.result(timeout=10) == 10
+
+
+def test_num_returns(executor):
+    futs = executor.submit(lambda: (1, 2, 3), num_returns=3)
+    assert [f.result(timeout=5) for f in futs] == [1, 2, 3]
+
+
+def test_num_returns_mismatch(executor):
+    futs = executor.submit(lambda: (1, 2), num_returns=3)
+    with pytest.raises(ValueError):
+        futs[0].result(timeout=5)
+
+
+def test_exception_propagates(executor):
+    def boom():
+        raise ValueError("boom")
+
+    fut = executor.submit(boom)
+    with pytest.raises(ValueError, match="boom"):
+        fut.result(timeout=5)
+    # A consumer of a failed future fails with the same error.
+    downstream = executor.submit(lambda x: x, (fut,))
+    with pytest.raises(ValueError, match="boom"):
+        downstream.result(timeout=5)
+
+
+def test_serial_lane_ordering(executor):
+    lane = executor.new_lane()
+    log = []
+
+    def slow():
+        time.sleep(0.05)
+        log.append("first")
+
+    def fast():
+        log.append("second")
+
+    f1 = executor.submit(slow, lane=lane)
+    f2 = executor.submit(fast, lane=lane)
+    f1.result(timeout=5)
+    f2.result(timeout=5)
+    assert log == ["first", "second"]

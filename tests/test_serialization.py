@@ -1,0 +1,114 @@
+"""Payload serialization tests: array fast path + whitelist
+(whitelist behavior mirrors ref
+``fed/tests/serializations_tests/test_unpickle_with_whitelist.py``)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from rayfed_tpu._private import serialization as ser
+
+
+def roundtrip(data, allowed=None):
+    kind, meta, buffers = ser.encode_payload(data)
+    payload = ser.concat_buffers(buffers)
+    return kind, ser.decode_payload(kind, meta, payload, allowed)
+
+
+def test_array_tree_fast_path():
+    data = {
+        "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.ones(4, dtype=np.float64),
+        "step": 7,
+        "name": "layer0",
+        "nested": [np.int32(3), {"flag": True, "none": None}],
+    }
+    kind, out = roundtrip(data)
+    assert kind == "tree"
+    np.testing.assert_array_equal(out["w"], data["w"])
+    np.testing.assert_array_equal(out["b"], data["b"])
+    assert out["step"] == 7 and out["name"] == "layer0"
+    assert out["nested"][1] == {"flag": True, "none": None}
+
+
+def test_zero_dim_and_empty_arrays():
+    kind, out = roundtrip({"s": np.float32(2.5), "e": np.zeros((0, 3))})
+    assert kind == "tree"
+    assert out["s"] == np.float32(2.5)
+    assert out["e"].shape == (0, 3)
+
+
+def test_bfloat16_roundtrip():
+    import ml_dtypes
+
+    arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    kind, out = roundtrip([arr])
+    assert kind == "tree"
+    assert out[0].dtype == arr.dtype
+    np.testing.assert_array_equal(out[0], arr)
+
+
+def test_jax_array_fast_path():
+    import jax.numpy as jnp
+
+    arr = jnp.arange(16.0).reshape(4, 4)
+    kind, out = roundtrip({"g": arr})
+    assert kind == "tree"
+    np.testing.assert_array_equal(out["g"], np.asarray(arr))
+
+
+def test_noncontiguous_array():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4).T  # F-contiguous view
+    kind, out = roundtrip(arr)
+    assert kind == "tree"
+    np.testing.assert_array_equal(out, arr)
+
+
+class Custom:
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return isinstance(other, Custom) and other.v == self.v
+
+
+def test_pickle_fallback_for_custom_objects():
+    kind, out = roundtrip(Custom(5))
+    assert kind == "pickle"
+    assert out == Custom(5)
+
+
+def test_namedtuple_falls_back_to_pickle():
+    from collections import namedtuple
+
+    P = globals().setdefault("_P", namedtuple("_P", "x y"))
+    kind, _, _ = ser.encode_payload(P(1, 2))
+    assert kind == "pickle"
+
+
+def test_whitelist_blocks_non_whitelisted_class():
+    blob = ser.dumps(Custom(5))
+    with pytest.raises(pickle.UnpicklingError):
+        ser.restricted_loads(blob, {"numpy": ["ndarray"]})
+
+
+def test_whitelist_allows_listed_class():
+    blob = ser.dumps(Custom(5))
+    out = ser.restricted_loads(blob, {__name__: ["Custom"]})
+    assert out == Custom(5)
+
+
+def test_whitelist_wildcard():
+    blob = ser.dumps(Custom(5))
+    out = ser.restricted_loads(blob, {__name__: ["*"]})
+    assert out == Custom(5)
+
+
+def test_fed_remote_error_always_unpicklable():
+    from rayfed_tpu.exceptions import FedRemoteError
+
+    blob = ser.dumps(FedRemoteError("alice", "cause"))
+    out = ser.restricted_loads(blob, {"numpy": ["ndarray"]})
+    assert isinstance(out, FedRemoteError)
+    assert out.src_party == "alice"

@@ -1,0 +1,62 @@
+"""Pytree unit tests (mirror of ref
+``fed/tests/without_ray_tests/test_tree_utils.py``)."""
+
+from collections import OrderedDict, namedtuple
+
+import pytest
+
+from rayfed_tpu.tree_util import tree_flatten, tree_map, tree_unflatten
+
+Point = namedtuple("Point", ["x", "y"])
+
+
+@pytest.mark.parametrize(
+    "tree",
+    [
+        1,
+        None,
+        "leaf",
+        [1, 2, 3],
+        (1, (2, 3)),
+        {"a": 1, "b": [2, {"c": 3}]},
+        OrderedDict([("z", 1), ("a", 2)]),
+        Point(1, Point(2, 3)),
+        {"mix": [Point(1, 2), (None, OrderedDict())]},
+        [],
+        {},
+    ],
+)
+def test_roundtrip(tree):
+    leaves, spec = tree_flatten(tree)
+    assert tree_unflatten(leaves, spec) == tree
+    assert spec.num_leaves == len(leaves)
+
+
+def test_flatten_order_is_deterministic():
+    tree = {"b": 2, "a": 1}
+    leaves, _ = tree_flatten(tree)
+    # Insertion order, matching dict semantics.
+    assert leaves == [2, 1]
+
+
+def test_namedtuple_type_preserved():
+    leaves, spec = tree_flatten(Point(1, 2))
+    out = tree_unflatten([10, 20], spec)
+    assert isinstance(out, Point) and out == Point(10, 20)
+
+
+def test_ordered_dict_order_preserved():
+    od = OrderedDict([("z", 1), ("a", 2)])
+    leaves, spec = tree_flatten(od)
+    out = tree_unflatten(leaves, spec)
+    assert list(out.keys()) == ["z", "a"]
+
+
+def test_leaf_count_mismatch_raises():
+    _, spec = tree_flatten([1, 2])
+    with pytest.raises(ValueError):
+        tree_unflatten([1, 2, 3], spec)
+
+
+def test_tree_map():
+    assert tree_map(lambda x: x * 2, {"a": [1, 2]}) == {"a": [2, 4]}

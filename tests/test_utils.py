@@ -1,0 +1,46 @@
+"""Address validation and misc utils (mirror of ref
+``fed/tests/without_ray_tests/test_utils.py``)."""
+
+import pytest
+
+from rayfed_tpu.utils import dict2tuple, validate_address, validate_addresses
+
+
+@pytest.mark.parametrize(
+    "addr",
+    ["127.0.0.1:8000", "localhost:1", "my-host.example.com:65535"],
+)
+def test_valid_addresses(addr):
+    validate_address(addr)
+
+
+@pytest.mark.parametrize(
+    "addr",
+    [
+        "http://127.0.0.1:8000",
+        "127.0.0.1",
+        "127.0.0.1:0",
+        "127.0.0.1:99999",
+        "127.0.0.1:port",
+        ":8000",
+        12345,
+    ],
+)
+def test_invalid_addresses(addr):
+    with pytest.raises(ValueError):
+        validate_address(addr)
+
+
+def test_validate_addresses_dict():
+    validate_addresses({"alice": "127.0.0.1:1234", "bob": "127.0.0.1:1235"})
+    with pytest.raises(ValueError):
+        validate_addresses({})
+    with pytest.raises(ValueError):
+        validate_addresses({"alice": "nope"})
+    with pytest.raises(ValueError):
+        validate_addresses({"": "127.0.0.1:1234"})
+
+
+def test_dict2tuple():
+    assert dict2tuple({"b": 1, "a": 2}) == (("a", 2), ("b", 1))
+    assert dict2tuple(None) == ()

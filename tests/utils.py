@@ -1,0 +1,70 @@
+"""Shared helpers for multi-party tests.
+
+The canonical multi-party-without-a-cluster trick from the reference test
+suite (``fed/tests/test_fed_get.py:50-95``): one OS process per party, all
+parties share localhost addresses, asserts run inside the children, and the
+parent checks exit codes.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import socket
+from typing import Callable, Dict, List, Optional
+
+# 'spawn' gives each party a pristine interpreter (no inherited JAX/global
+# context), matching the reference's per-party Ray clusters in spirit.
+MP = multiprocessing.get_context("spawn")
+
+# Fast retry policy for tests: peers come up within milliseconds of each
+# other; the reference-parity default (5s initial backoff) only slows CI.
+FAST_COMM_CONFIG = {
+    "retry_policy": {
+        "max_attempts": 20,
+        "initial_backoff_ms": 100,
+        "max_backoff_ms": 1000,
+        "backoff_multiplier": 1.5,
+    }
+}
+
+
+def get_addresses(parties: List[str]) -> Dict[str, str]:
+    """Pick a free localhost port per party."""
+    addresses = {}
+    socks = []
+    for party in parties:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addresses[party] = f"127.0.0.1:{s.getsockname()[1]}"
+    for s in socks:
+        s.close()
+    return addresses
+
+
+def run_parties(
+    target: Callable,
+    parties: List[str],
+    timeout: float = 120,
+    extra_args: tuple = (),
+    addresses: Optional[Dict[str, str]] = None,
+) -> None:
+    """Spawn ``target(party, addresses, *extra_args)`` per party; assert all
+    exit 0."""
+    addresses = addresses or get_addresses(parties)
+    procs = {
+        party: MP.Process(
+            target=target, args=(party, addresses) + extra_args, name=f"party-{party}"
+        )
+        for party in parties
+    }
+    for p in procs.values():
+        p.start()
+    for party, p in procs.items():
+        p.join(timeout=timeout)
+        if p.is_alive():
+            for q in procs.values():
+                q.terminate()
+            raise AssertionError(f"party {party} timed out after {timeout}s")
+    bad = {party: p.exitcode for party, p in procs.items() if p.exitcode != 0}
+    assert not bad, f"party processes failed with exit codes: {bad}"
