@@ -170,8 +170,6 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
 
     retry_policy: Optional[Dict[str, Any]] = None
     connect_timeout_in_ms: int = 10000
-    # Chunk size for socket writes of large payloads.
-    write_chunk_bytes: int = 4 * 1024 * 1024
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)

@@ -1,0 +1,204 @@
+"""Decoder-only transformer LM (functional JAX, TPU-first).
+
+The reference ships no models (its engine moves opaque payloads); this is
+the flagship model family for federated LM training on party meshes — the
+driver's graft entry jits its forward, and ``parallel/`` shards its train
+step over party/data/model/seq mesh axes.
+
+TPU-first design choices:
+ - layer parameters are **stacked** along a leading (n_layers, ...) axis and
+   the forward is a single ``lax.scan`` over layers: one compiled layer body
+   regardless of depth, XLA-friendly, and the stacked leaves shard cleanly;
+ - matmul-heavy blocks (QKV/O projections, SwiGLU) are einsums that tile
+   onto the MXU; compute dtype is configurable (bf16 by default) with
+   params and softmax/logsumexp accumulation kept in f32;
+ - RoPE + causal attention with an optional ring-attention path
+   (:mod:`rayfed_tpu.parallel.ring`) for sequence-parallel long context.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1408  # SwiGLU hidden width
+    rope_theta: float = 10000.0
+    compute_dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def tiny_config(**overrides) -> TransformerConfig:
+    """A config small enough to compile in seconds on one chip / CPU sim."""
+    base = dict(vocab=256, d_model=64, n_heads=4, n_layers=2, d_ff=176)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng, cfg: TransformerConfig) -> Params:
+    d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape) * (fan_in**-0.5)).astype(
+            cfg.param_dtype
+        )
+
+    def layer(key):
+        ks = jax.random.split(key, 7)
+        return {
+            "ln1": jnp.ones((d,), cfg.param_dtype),
+            "wq": dense(ks[0], (d, h, dh), d),
+            "wk": dense(ks[1], (d, h, dh), d),
+            "wv": dense(ks[2], (d, h, dh), d),
+            "wo": dense(ks[3], (h, dh, d), h * dh),
+            "ln2": jnp.ones((d,), cfg.param_dtype),
+            "w_gate": dense(ks[4], (d, f), d),
+            "w_up": dense(ks[5], (d, f), d),
+            "w_down": dense(ks[6], (f, d), f),
+        }
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[layer(k) for k in layer_keys]
+    )
+    return {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab, d)) * 0.02).astype(
+            cfg.param_dtype
+        ),
+        "layers": stacked,
+        "ln_f": jnp.ones((d,), cfg.param_dtype),
+        # Untied output head: keeps vocab-dim sharding independent.
+        "lm_head": dense(k_out, (d, cfg.vocab), d),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv).astype(dtype) * scale.astype(dtype)
+
+
+def rope(q, k, positions, theta: float):
+    """Rotary position embedding on (B, S, H, Dh) q/k."""
+    dh = q.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, half)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        )
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def causal_attention(q, k, v, q_offset=None):
+    """Standard causal attention on (B, S, H, Dh); softmax in f32.
+
+    ``q_offset`` shifts query positions (used by sequence-parallel callers
+    where this shard's queries start at a global offset).
+    """
+    b, sq, h, dh = q.shape
+    sk = k.shape[1]
+    scale = dh**-0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = jnp.arange(sq)[:, None] + (0 if q_offset is None else q_offset)
+    mask = q_pos >= jnp.arange(sk)[None, :]
+    scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+AttnFn = Callable[..., jax.Array]
+
+
+def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
+             attn_fn: Optional[AttnFn] = None):
+    """One pre-norm decoder block; ``attn_fn(q, k, v)`` is pluggable so
+    sequence-parallel callers can swap in ring attention."""
+    attn_fn = attn_fn or causal_attention
+    cdt = cfg.compute_dtype
+    h = rms_norm(x, layer["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cdt))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cdt))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cdt))
+    q, k = rope(q, k, positions, cfg.rope_theta)
+    o = attn_fn(q, k, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
+    hmlp = rms_norm(x, layer["ln2"])
+    gate = jax.nn.silu(hmlp @ layer["w_gate"].astype(cdt))
+    up = hmlp @ layer["w_up"].astype(cdt)
+    x = x + (gate * up) @ layer["w_down"].astype(cdt)
+    return x
+
+
+def forward(params: Params, tokens, cfg: TransformerConfig,
+            attn_fn: Optional[AttnFn] = None,
+            positions=None) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) float32.
+
+    Layers run under one ``lax.scan`` over the stacked parameters.
+    """
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    x = params["embed"][tokens].astype(cfg.compute_dtype)
+
+    def body(x, layer):
+        return layer_fn(x, layer, positions, cfg, attn_fn), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["ln_f"])
+    return (x @ params["lm_head"].astype(cfg.compute_dtype)).astype(jnp.float32)
+
+
+def lm_loss_pair(params: Params, inputs, targets, cfg: TransformerConfig,
+                 attn_fn: Optional[AttnFn] = None) -> jax.Array:
+    """Next-token cross entropy over pre-shifted (inputs, targets) pairs,
+    both (B, S) — the sharding-friendly form (S stays divisible by the seq
+    axis; no in-jit slicing of sharded dims). f32 accumulation."""
+    logits = forward(params, inputs, cfg, attn_fn)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (logz - gold).mean()
+
+
+def lm_loss(params: Params, tokens, cfg: TransformerConfig,
+            attn_fn: Optional[AttnFn] = None) -> jax.Array:
+    """Next-token cross entropy over a (B, S+1) token block."""
+    return lm_loss_pair(params, tokens[:, :-1], tokens[:, 1:], cfg, attn_fn)

@@ -1,0 +1,99 @@
+"""Parameter partition rules and mesh helpers for the model families.
+
+Megatron-style tensor parallelism expressed as GSPMD sharding rules: QKV
+projections and MLP up/gate shard their output features over the ``model``
+axis, output/down projections shard their input features, so each block is
+one all-reduce per residual add (inserted automatically by XLA). Batch dims
+shard over ``party`` x ``data`` (federated data parallelism: the gradient
+all-reduce over ``party`` IS the FedAvg aggregate), and activations can
+additionally shard the sequence dim over ``seq``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (regex over param path, spec) — first match wins. Paths look like
+# "layers/wq", "embed", "layers/w_down". Stacked layer leaves carry a
+# leading n_layers dim, handled by _prepend_none below.
+TRANSFORMER_RULES: List[Tuple[str, P]] = [
+    (r"layers/w[qkv]$", P(None, "model", None)),
+    (r"layers/wo$", P("model", None, None)),
+    (r"layers/w_(gate|up)$", P(None, "model")),
+    (r"layers/w_down$", P("model", None)),
+    (r"layers/ln[12]$", P()),
+    (r"embed$", P(None, None)),
+    (r"lm_head$", P(None, "model")),
+    (r"ln_f$", P()),
+]
+
+MLP_RULES: List[Tuple[str, P]] = [
+    (r"layers/\d+/w$", P(None, "model")),
+    (r"layers/\d+/b$", P("model")),
+    (r".*", P()),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for(path_str: str, leaf, rules, stacked_prefix: str = "layers") -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path_str):
+            if (
+                stacked_prefix
+                and path_str.startswith(stacked_prefix)
+                and len(spec) == leaf.ndim - 1
+            ):
+                # Stacked layer leaf: leading n_layers dim is unsharded.
+                return P(*((None,) + tuple(spec)))
+            return spec
+    return P()
+
+
+def make_param_specs(params, rules=TRANSFORMER_RULES):
+    """Pytree of PartitionSpec matching ``params`` by path regex."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(_path_str(path), leaf, rules), params
+    )
+
+
+def make_param_shardings(mesh: Mesh, params, rules=TRANSFORMER_RULES):
+    specs = make_param_specs(params, rules)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_params(mesh: Mesh, params, rules=TRANSFORMER_RULES):
+    """Place a (host or single-device) param tree onto the mesh per rules."""
+    shardings = make_param_shardings(mesh, params, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def batch_spec(mesh: Mesh, party_axis: Optional[str] = "party",
+               data_axis: Optional[str] = "data",
+               seq_axis: Optional[str] = None) -> P:
+    """PartitionSpec for a (B, S) token batch: batch over party x data,
+    optionally sequence over seq."""
+    batch_axes = tuple(
+        a for a in (party_axis, data_axis) if a and a in mesh.axis_names
+    )
+    first = batch_axes if batch_axes else None
+    if seq_axis and seq_axis in mesh.axis_names:
+        return P(first, seq_axis)
+    return P(first)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
@@ -106,6 +107,7 @@ class GrpcSenderProxy(SenderProxy):
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="fedtpu-grpc-send"
         )
+        self._stats_lock = threading.Lock()
         self._stats = {"send_op_count": 0}
 
     def start(self) -> None:
@@ -166,7 +168,8 @@ class GrpcSenderProxy(SenderProxy):
         )
         resp_bytes = stub(request, timeout=self._config.timeout_in_ms / 1000)
         resp = msgpack.unpackb(resp_bytes, raw=False)
-        self._stats["send_op_count"] += 1
+        with self._stats_lock:
+            self._stats["send_op_count"] += 1
         if resp["code"] == CODE_OK:
             return True
         logger.warning(

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
@@ -52,6 +53,11 @@ class RendezvousStore:
         self._lock = threading.Lock()
         self._arrived: Dict[Tuple[str, str], Tuple[Dict, memoryview]] = {}
         self._waiters: Dict[Tuple[str, str], Future] = {}
+        # Recently-delivered keys: a sender that lost an ack resends the
+        # same frame after reconnect; without this, the duplicate would
+        # park in _arrived forever (each (up, down) edge is consumed once).
+        self._consumed: "OrderedDict[Tuple[str, str], None]" = OrderedDict()
+        self._consumed_cap = 65536
         self._pool = ThreadPoolExecutor(
             max_workers=decode_workers, thread_name_prefix="fedtpu-recv-decode"
         )
@@ -82,14 +88,26 @@ class RendezvousStore:
         key = (header["up"], header["down"])
         with self._lock:
             self._stats["receive_op_count"] += 1
+            if key in self._consumed:
+                # Duplicate of an already-delivered frame (ack-lost resend):
+                # acknowledge and drop.
+                return CODE_OK, "duplicate"
             waiter = self._waiters.pop(key, None)
             if waiter is None:
                 # An error envelope substituting already-arrived data
                 # overwrites the slot (sender reuses the same seq ids).
                 self._arrived[key] = (header, payload)
+            else:
+                self._mark_consumed(key)
         if waiter is not None:
             self._pool.submit(self._decode_into, header, payload, waiter)
         return CODE_OK, "ok"
+
+    def _mark_consumed(self, key) -> None:
+        # Caller holds self._lock.
+        self._consumed[key] = None
+        while len(self._consumed) > self._consumed_cap:
+            self._consumed.popitem(last=False)
 
     # -- consumer side -----------------------------------------------------
 
@@ -99,6 +117,7 @@ class RendezvousStore:
         with self._lock:
             if key in self._arrived:
                 header, payload = self._arrived.pop(key)
+                self._mark_consumed(key)
             else:
                 self._waiters[key] = out
                 return out

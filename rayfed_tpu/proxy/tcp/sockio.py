@@ -1,0 +1,90 @@
+"""Blocking-socket frame IO for the FTP1 wire protocol.
+
+The data plane runs on dedicated threads with blocking sockets:
+``sendall`` over memoryviews on the way out, ``recv_into`` a preallocated
+``bytearray`` on the way in — one copy each side, measured ~20x faster than
+asyncio streams on this workload (loopback ceiling ~2.9 GB/s vs ~0.13 GB/s
+through StreamReader). Frame layout is defined in
+:mod:`rayfed_tpu.proxy.tcp.wire`.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from rayfed_tpu.proxy.tcp import wire
+
+_SOCK_BUF = 8 * 1024 * 1024
+
+
+def tune_socket(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _SOCK_BUF)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _SOCK_BUF)
+    except OSError:  # pragma: no cover - platform-specific
+        pass
+
+
+def send_frame(sock: socket.socket, ftype: int, header: Dict,
+               buffers: Optional[List] = None) -> None:
+    buffers = buffers or []
+    payload_len = sum(memoryview(b).nbytes for b in buffers)
+    sock.sendall(wire.encode_prefix_and_header(ftype, header, payload_len))
+    for buf in buffers:
+        view = wire.as_byte_view(buf)
+        if view.nbytes:
+            sock.sendall(view)
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    total = view.nbytes
+    while got < total:
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("peer closed connection mid-frame")
+        got += n
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def recv_frame(
+    sock: socket.socket,
+    max_payload: Optional[int] = None,
+) -> Tuple[int, Dict, memoryview]:
+    """Blocking read of one frame. Size caps are enforced before the
+    payload is buffered, so an oversized frame costs no memory — the
+    connection is torn down instead of answered. Payload is a writable
+    numpy-backed view."""
+    prefix = _recv_exact(sock, wire.PREFIX_LEN)
+    magic, version, ftype, hlen, plen = wire._PREFIX.unpack(bytes(prefix))
+    if magic != wire.WIRE_MAGIC:
+        raise wire.WireError(f"bad magic {magic!r}")
+    if version != wire.WIRE_VERSION:
+        raise wire.WireError(f"unsupported wire version {version}")
+    if hlen > wire._MAX_HEADER:
+        raise wire.WireError(f"header length {hlen} exceeds cap")
+    cap = wire._MAX_PAYLOAD if max_payload is None else min(
+        max_payload, wire._MAX_PAYLOAD
+    )
+    if plen > cap:
+        raise wire.WireError(f"payload length {plen} exceeds cap {cap}")
+    header = msgpack.unpackb(bytes(_recv_exact(sock, hlen)), raw=False)
+    if not plen:
+        return ftype, header, memoryview(b"")
+    # np.empty skips the zero-fill a bytearray would pay (~47ms/100MB —
+    # pure waste since recv_into overwrites every byte) and halves page
+    # traffic on fresh buffers; the returned view stays writable.
+    import numpy as np
+
+    payload = np.empty(plen, dtype=np.uint8)
+    _recv_exact_into(sock, memoryview(payload))
+    return ftype, header, memoryview(payload)

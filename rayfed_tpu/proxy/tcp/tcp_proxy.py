@@ -1,4 +1,4 @@
-"""Default native transport: asyncio TCP sender/receiver proxies.
+"""Default native transport: threaded blocking-socket sender/receiver.
 
 Capability parity with the reference's gRPC transport
 (``fed/proxy/grpc/grpc_proxy.py``):
@@ -13,17 +13,23 @@ Capability parity with the reference's gRPC transport
  - mutual TLS (ref grpc_proxy.py:124-141,362-372);
  - per-proxy op-count stats (ref barriers.py:132,154,204,223).
 
-TPU-first difference: payloads ride the array fast path
-(``serialization.try_encode_tree``) so a gradient pytree crosses the wire as
-raw device bytes + a msgpack skeleton — no cloudpickle on the hot loop.
+TPU-first differences: payloads ride the array fast path
+(``serialization.try_encode_tree``) — raw device bytes + a msgpack
+skeleton, no cloudpickle on the hot loop — and the data plane is blocking
+sockets on dedicated threads (one sender worker per destination, one reader
+thread per inbound connection), which sustains loopback/NIC line rate where
+event-loop streaming tops out ~20x lower (see sockio.py).
 """
 
 from __future__ import annotations
 
-import asyncio
 import logging
+import socket
+import ssl
 import threading
-from concurrent.futures import Future, ThreadPoolExecutor
+import time
+from concurrent.futures import Future
+from queue import Queue
 from typing import Dict, Optional, Tuple
 
 from rayfed_tpu._private import serialization
@@ -33,32 +39,9 @@ from rayfed_tpu.exceptions import FedLocalError
 from rayfed_tpu.proxy import rendezvous
 from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
-from rayfed_tpu.proxy.tcp import wire
+from rayfed_tpu.proxy.tcp import sockio, wire
 
 logger = logging.getLogger(__name__)
-
-
-class _LoopThread:
-    """An asyncio event loop running on a dedicated daemon thread."""
-
-    def __init__(self, name: str):
-        self.loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
-
-    def _run(self) -> None:
-        asyncio.set_event_loop(self.loop)
-        self.loop.run_forever()
-
-    def start(self) -> None:
-        self._thread.start()
-
-    def run_coro(self, coro) -> Future:
-        return asyncio.run_coroutine_threadsafe(coro, self.loop)
-
-    def stop(self) -> None:
-        if self._thread.is_alive():
-            self.loop.call_soon_threadsafe(self.loop.stop)
-            self._thread.join(timeout=5)
 
 
 def _parse_addr(addr: str) -> Tuple[str, int]:
@@ -66,198 +49,227 @@ def _parse_addr(addr: str) -> Tuple[str, int]:
     return host, int(port)
 
 
-class TcpSenderProxy(SenderProxy):
-    def __init__(self, addresses, party, job_name, tls_config, proxy_config=None):
-        super().__init__(addresses, party, job_name, tls_config, proxy_config)
-        self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
-        self._loop_thread = _LoopThread(f"fedtpu-sender-{party}")
-        self._conns: Dict[str, Tuple[asyncio.StreamReader, asyncio.StreamWriter]] = {}
-        self._conn_locks: Dict[str, asyncio.Lock] = {}
-        self._encode_pool = ThreadPoolExecutor(
-            max_workers=2, thread_name_prefix="fedtpu-send-encode"
+class _DestWorker(threading.Thread):
+    """Owns the persistent connection to one destination party and executes
+    its send jobs in order (the reference serializes per-dest sends on one
+    channel the same way)."""
+
+    def __init__(self, proxy: "TcpSenderProxy", dest_party: str):
+        super().__init__(name=f"fedtpu-send-{dest_party}", daemon=True)
+        self._proxy = proxy
+        self._dest = dest_party
+        self._jobs: Queue = Queue()
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        self._lane = None
+        if not wire.tls_enabled(proxy._tls_config):
+            # Plaintext connections pipeline frames (window of unacked
+            # sends); TLS keeps half-duplex request-response because
+            # ssl.SSLSocket cannot be read and written concurrently.
+            from rayfed_tpu.proxy.tcp.pipeline import PipelinedLane
+
+            policy = proxy._config.get_retry_policy()
+
+            def backoff_s(attempt: int) -> float:
+                return min(
+                    (policy.initial_backoff_ms / 1000)
+                    * policy.backoff_multiplier**attempt,
+                    policy.max_backoff_ms / 1000,
+                )
+
+            def bump_acks() -> None:
+                proxy._bump_stat("send_op_count")
+
+            self._lane = PipelinedLane(
+                dest_party,
+                connect=lambda attempts: self._fresh_sock(attempts),
+                max_attempts=policy.max_attempts,
+                backoff_s=backoff_s,
+                ack_timeout_s=proxy._config.timeout_in_ms / 1000,
+                on_ack=bump_acks,
+            )
+        self.start()
+
+    def submit(self, job) -> None:
+        self._jobs.put(job)
+
+    def close(self) -> None:
+        self._closed = True
+        self._jobs.put(None)
+        if self._lane is not None:
+            self._lane.close()
+
+    # -- connection management ----------------------------------------------
+
+    def _connect_once(self, op_timeout: Optional[float] = -1) -> socket.socket:
+        host, port = _parse_addr(self._proxy._addresses[self._dest])
+        cfg = self._proxy._config
+        raw = socket.create_connection(
+            (host, port), timeout=cfg.connect_timeout_in_ms / 1000
         )
-        self._stats = {"send_op_count": 0}
-        self._started = False
-
-    def start(self) -> None:
-        if not self._started:
-            self._loop_thread.start()
-            self._started = True
-
-    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
-             is_error: bool = False) -> Future:
-        return self._loop_thread.run_coro(
-            self._send(dest_party, data, upstream_seq_id, downstream_seq_id, is_error)
+        sockio.tune_socket(raw)
+        if wire.tls_enabled(self._proxy._tls_config):
+            ctx = wire.make_client_ssl_context(self._proxy._tls_config)
+            raw = ctx.wrap_socket(raw)
+        raw.settimeout(
+            cfg.timeout_in_ms / 1000 if op_timeout == -1 else op_timeout
         )
+        return raw
 
-    def get_stats(self) -> Dict:
-        return dict(self._stats)
-
-    def get_proxy_config(self, dest_party: Optional[str] = None):
-        """Expose the effective messaging config (ref grpc_proxy.py:170-177,
-        pinned by ``fed/tests/test_retry_policy.py``-style config tests)."""
-        return self._config
-
-    def stop(self) -> None:
-        async def _close_all() -> None:
-            for _, writer in self._conns.values():
-                writer.close()
-            self._conns.clear()
-
-        if self._started:
-            try:
-                self._loop_thread.run_coro(_close_all()).result(timeout=5)
-            except Exception:  # noqa: BLE001 - best-effort close
-                pass
-            self._loop_thread.stop()
-        self._encode_pool.shutdown(wait=False)
-
-    # -- internals ---------------------------------------------------------
-
-    async def _connect(self, dest_party: str):
-        host, port = _parse_addr(self._addresses[dest_party])
-        ssl_ctx = (
-            wire.make_client_ssl_context(self._tls_config)
-            if wire.tls_enabled(self._tls_config)
-            else None
-        )
-        connect_timeout = self._config.connect_timeout_in_ms / 1000
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port, ssl=ssl_ctx),
-            timeout=connect_timeout,
-        )
-        return reader, writer
-
-    async def _get_conn(self, dest_party: str, max_attempts: Optional[int] = None):
-        conn = self._conns.get(dest_party)
-        if conn is not None and not conn[1].is_closing():
-            return conn
-        policy = self._config.get_retry_policy()
-        attempts = max_attempts if max_attempts is not None else policy.max_attempts
+    def _connect_retry(self, max_attempts: Optional[int],
+                       op_timeout) -> socket.socket:
+        """Connect with the retry policy. ``op_timeout`` is the blocking-op
+        timeout installed on the resulting socket (-1 = config default)."""
+        policy = self._proxy._config.get_retry_policy()
+        attempts = max_attempts or policy.max_attempts
         backoff = policy.initial_backoff_ms / 1000
         last_err: Optional[Exception] = None
         for attempt in range(attempts):
             try:
-                conn = await self._connect(dest_party)
-                self._conns[dest_party] = conn
-                return conn
-            except (OSError, asyncio.TimeoutError) as e:
+                return self._connect_once(op_timeout=op_timeout)
+            except OSError as e:
                 last_err = e
                 logger.debug(
                     "connect to %s failed (attempt %d/%d): %s",
-                    dest_party, attempt + 1, attempts, e,
+                    self._dest, attempt + 1, attempts, e,
                 )
                 if attempt + 1 < attempts:
-                    await asyncio.sleep(backoff)
+                    time.sleep(backoff)
                     backoff = min(
                         backoff * policy.backoff_multiplier,
                         policy.max_backoff_ms / 1000,
                     )
         raise ConnectionError(
-            f"cannot reach party {dest_party} at "
-            f"{self._addresses[dest_party]} after {attempts} "
+            f"cannot reach party {self._dest} at "
+            f"{self._proxy._addresses[self._dest]} after {attempts} "
             f"attempts: {last_err}"
         )
 
-    async def _send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
-                    is_error: bool) -> bool:
-        # 1. Resolve the value future; a producer failure becomes a
-        #    FedLocalError so the drain thread can substitute an error
-        #    envelope (the reference's RayError branch, cleanup.py:160-172).
+    def _fresh_sock(self, max_attempts: Optional[int] = None) -> socket.socket:
+        """Pipelined-lane socket: blocking ops bounded by the send timeout
+        so a stalled peer surfaces as socket.timeout instead of wedging the
+        writer/reader threads; the lane maps idle reader timeouts back to
+        'keep waiting' when nothing is in flight."""
+        return self._connect_retry(
+            max_attempts, op_timeout=self._proxy._config.timeout_in_ms / 1000
+        )
+
+    def _get_sock(self, max_attempts: Optional[int] = None) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        self._sock = self._connect_retry(max_attempts, op_timeout=-1)
+        return self._sock
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # -- job loop -------------------------------------------------------------
+
+    def run(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                self._drop_sock()
+                return
+            out, data, upstream_seq_id, downstream_seq_id, is_error = job
+            try:
+                header, buffers = self._prepare(
+                    data, upstream_seq_id, downstream_seq_id, is_error
+                )
+            except BaseException as e:  # noqa: BLE001 - routed to drain
+                out.set_exception(e)
+                continue
+            if self._lane is not None:
+                self._lane.submit(out, header, buffers)
+                continue
+            try:
+                out.set_result(self._send_half_duplex(header, buffers))
+            except BaseException as e:  # noqa: BLE001 - routed to drain
+                out.set_exception(e)
+
+    def _prepare(self, data, upstream_seq_id, downstream_seq_id,
+                 is_error: bool):
+        # Resolve the value future; a producer failure becomes a
+        # FedLocalError so the drain thread can substitute an error
+        # envelope (the reference's RayError branch, cleanup.py:160-172).
         if isinstance(data, Future):
             try:
-                value = await asyncio.wrap_future(data)
+                value = data.result()
             except BaseException as e:  # noqa: BLE001
                 raise FedLocalError(e) from None
         else:
             value = data
 
-        # 2. Encode off-loop (device->host copies for big arrays).
-        loop = asyncio.get_running_loop()
-        kind, meta, buffers = await loop.run_in_executor(
-            self._encode_pool, serialization.encode_payload, value
-        )
+        kind, meta, buffers = serialization.encode_payload(value)
         payload_len = sum(serialization.buffer_nbytes(b) for b in buffers)
-        max_size = self._config.messages_max_size_in_bytes
-        if max_size is not None and payload_len > max_size:
+        cfg = self._proxy._config
+        if (
+            cfg.messages_max_size_in_bytes is not None
+            and payload_len > cfg.messages_max_size_in_bytes
+        ):
             raise ValueError(
                 f"payload of {payload_len} bytes exceeds "
-                f"messages_max_size_in_bytes={max_size}"
+                f"messages_max_size_in_bytes={cfg.messages_max_size_in_bytes}"
             )
-
         header = {
-            "job": self._job_name,
-            "src": self._party,
+            "job": self._proxy._job_name,
+            "src": self._proxy._party,
             "up": str(upstream_seq_id),
             "down": str(downstream_seq_id),
             "is_error": bool(is_error),
             "pkind": kind,
             "pmeta": meta,
         }
+        return header, buffers
 
-        # 3. One in-flight frame per connection: request/response in order.
-        #    Connection-level failures retry with a reconnect (a persistent
-        #    connection may have gone stale between sends — the reference
-        #    gets the same resilience from gRPC's in-channel retry policy,
-        #    grpc_options.py:19-25). Timeouts do NOT retry, mirroring
-        #    retryableStatusCodes=[UNAVAILABLE] only.
-        lock = self._conn_locks.setdefault(dest_party, asyncio.Lock())
-        timeout = self._config.timeout_in_ms / 1000
-        policy = self._config.get_retry_policy()
+    def _send_half_duplex(self, header, buffers) -> bool:
+        # TLS path. Send with bounded reconnect: first attempt gets the
+        # full connect budget (peer may still be starting — the reference
+        # rides gRPC's in-channel retry policy for this), a reconnect
+        # after a stale connection gets one try, so the total budget
+        # stays ~2x the policy rather than attempts^2.
+        cfg = self._proxy._config
+        policy = cfg.get_retry_policy()
         backoff = policy.initial_backoff_ms / 1000
         last_err: Optional[BaseException] = None
-        async with lock:
-            for attempt in range(policy.max_attempts):
-                # First attempt may wait out peer startup with the full
-                # connect budget; reconnects after a stale connection get a
-                # single try so the total send budget stays ~2x the policy,
-                # not attempts^2.
-                reader, writer = await self._get_conn(
-                    dest_party, max_attempts=None if attempt == 0 else 1
+        for attempt in range(policy.max_attempts):
+            sock = self._get_sock(max_attempts=None if attempt == 0 else 1)
+            try:
+                sockio.send_frame(sock, wire.FTYPE_DATA, header, buffers)
+                ftype, resp, _ = sockio.recv_frame(
+                    sock, max_payload=wire.MAX_RESP_FRAME
                 )
-                try:
-                    await asyncio.wait_for(
-                        wire.write_frame(
-                            writer, wire.FTYPE_DATA, header, buffers,
-                            chunk_bytes=self._config.write_chunk_bytes,
-                        ),
-                        timeout=timeout,
-                    )
-                    ftype, resp, _ = await asyncio.wait_for(
-                        wire.read_frame(reader, max_payload=wire.MAX_RESP_FRAME),
-                        timeout=timeout,
-                    )
-                    break
-                except asyncio.TimeoutError:
-                    writer.close()
-                    self._conns.pop(dest_party, None)
-                    raise
-                except (OSError, asyncio.IncompleteReadError) as e:
-                    writer.close()
-                    self._conns.pop(dest_party, None)
-                    last_err = e
-                    logger.debug(
-                        "send to %s failed on stale connection "
-                        "(attempt %d/%d): %s",
-                        dest_party, attempt + 1, policy.max_attempts, e,
-                    )
-                    if attempt + 1 < policy.max_attempts:
-                        await asyncio.sleep(backoff)
-                        backoff = min(
-                            backoff * policy.backoff_multiplier,
-                            policy.max_backoff_ms / 1000,
-                        )
-            else:
-                raise ConnectionError(
-                    f"send to {dest_party} failed after "
-                    f"{policy.max_attempts} attempts: {last_err}"
+                break
+            except socket.timeout:
+                self._drop_sock()
+                raise
+            except (OSError, ConnectionError, ssl.SSLError) as e:
+                self._drop_sock()
+                last_err = e
+                logger.debug(
+                    "send to %s failed on stale connection (attempt %d/%d): %s",
+                    self._dest, attempt + 1, policy.max_attempts, e,
                 )
-        self._stats["send_op_count"] += 1
+                if attempt + 1 < policy.max_attempts:
+                    time.sleep(backoff)
+                    backoff = min(
+                        backoff * policy.backoff_multiplier,
+                        policy.max_backoff_ms / 1000,
+                    )
+        else:
+            raise ConnectionError(
+                f"send to {self._dest} failed after "
+                f"{policy.max_attempts} attempts: {last_err}"
+            )
+
+        self._proxy._bump_stat("send_op_count")
         if ftype != wire.FTYPE_RESP:
             raise wire.WireError(f"expected RESP frame, got ftype={ftype}")
-        return self._handle_response(resp)
-
-    def _handle_response(self, resp: Dict) -> bool:
         code = resp.get("code")
         if code == CODE_OK:
             return True
@@ -269,119 +281,183 @@ class TcpSenderProxy(SenderProxy):
         raise RuntimeError(f"send rejected: code={code} {resp.get('msg')}")
 
 
+class TcpSenderProxy(SenderProxy):
+    def __init__(self, addresses, party, job_name, tls_config, proxy_config=None):
+        super().__init__(addresses, party, job_name, tls_config, proxy_config)
+        self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
+        self._workers: Dict[str, _DestWorker] = {}
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._stats = {"send_op_count": 0}
+
+    def _bump_stat(self, key: str) -> None:
+        # += on a dict value is not atomic across worker/reader threads.
+        with self._stats_lock:
+            self._stats[key] += 1
+
+    def start(self) -> None:
+        pass  # workers spin up lazily per destination
+
+    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
+             is_error: bool = False) -> Future:
+        out: Future = Future()
+        with self._lock:
+            worker = self._workers.get(dest_party)
+            if worker is None or worker._closed:
+                worker = _DestWorker(self, dest_party)
+                self._workers[dest_party] = worker
+        worker.submit((out, data, upstream_seq_id, downstream_seq_id, is_error))
+        return out
+
+    def get_stats(self) -> Dict:
+        return dict(self._stats)
+
+    def get_proxy_config(self, dest_party: Optional[str] = None):
+        """Expose the effective messaging config (ref grpc_proxy.py:170-177)."""
+        return self._config
+
+    def stop(self) -> None:
+        with self._lock:
+            workers = list(self._workers.values())
+            self._workers.clear()
+        for w in workers:
+            w.close()
+
+
 class TcpReceiverProxy(ReceiverProxy):
     def __init__(self, listen_addr, party, job_name, tls_config, proxy_config=None):
         super().__init__(listen_addr, party, job_name, tls_config, proxy_config)
         self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
-        self._loop_thread = _LoopThread(f"fedtpu-receiver-{party}")
         self._store = RendezvousStore(
             job_name,
             self._make_decode_fn(),
             max_payload_bytes=self._config.messages_max_size_in_bytes,
         )
-        self._server: Optional[asyncio.AbstractServer] = None
-        self._open_writers: set = set()
-        self._ready: Future = Future()
+        self._listener: Optional[socket.socket] = None
+        self._ready_result = None
+        self._open_conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._stopping = False
 
     def _make_decode_fn(self):
         """Hook: the TPU receiver overrides this to add device placement."""
         return rendezvous.default_decode(self._config.serializing_allowed_list)
 
-    # -- lifecycle ---------------------------------------------------------
+    # -- lifecycle ------------------------------------------------------------
 
     def start(self) -> None:
-        self._loop_thread.start()
-        self._loop_thread.run_coro(self._start_server())
-
-    async def _start_server(self) -> None:
         host, port = _parse_addr(self._listen_addr)
-        ssl_ctx = (
-            wire.make_server_ssl_context(self._tls_config)
-            if wire.tls_enabled(self._tls_config)
-            else None
-        )
         try:
-            self._server = await asyncio.start_server(
-                self._handle_conn, host, port, ssl=ssl_ctx
-            )
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind((host, port))
+            listener.listen(64)
         except OSError as e:
-            self._ready.set_result((False, f"failed to bind {self._listen_addr}: {e}"))
+            self._ready_result = (
+                False, f"failed to bind {self._listen_addr}: {e}"
+            )
             return
-        self._ready.set_result((True, None))
+        self._listener = listener
+        self._ready_result = (True, None)
+        threading.Thread(
+            target=self._accept_loop,
+            name=f"fedtpu-recv-accept-{self._party}",
+            daemon=True,
+        ).start()
 
     def is_ready(self, timeout: Optional[float] = None):
-        return self._ready.result(timeout=timeout)
+        return self._ready_result
+
+    def get_data(self, src_party, upstream_seq_id, curr_seq_id) -> Future:
+        return self._store.take(upstream_seq_id, curr_seq_id)
 
     def get_stats(self) -> Dict:
         return self._store.get_stats()
 
     def stop(self) -> None:
-        async def _close() -> None:
-            if self._server is not None:
-                self._server.close()
-            # Close live connections BEFORE wait_closed: on Python 3.12+
-            # Server.wait_closed blocks until every handler finishes, and
-            # handlers only finish once their connection drops.
-            for writer in list(self._open_writers):
-                writer.close()
-            if self._server is not None:
-                try:
-                    await asyncio.wait_for(self._server.wait_closed(), timeout=2)
-                except asyncio.TimeoutError:
-                    pass
-
-        try:
-            self._loop_thread.run_coro(_close()).result(timeout=5)
-        except Exception:  # noqa: BLE001 - best-effort close
-            pass
-        self._loop_thread.stop()
+        self._stopping = True
+        if self._listener is not None:
+            try:
+                # shutdown() wakes the thread blocked in accept(); a bare
+                # close() would leave it holding the kernel file description
+                # and the port in LISTEN state (breaks repeat fed.init on
+                # the same address).
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            conns = list(self._open_conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
         self._store.shutdown()
 
-    # -- data path ---------------------------------------------------------
+    # -- data path -------------------------------------------------------------
 
-    def get_data(self, src_party, upstream_seq_id, curr_seq_id) -> Future:
-        return self._store.take(upstream_seq_id, curr_seq_id)
+    def _accept_loop(self) -> None:
+        ssl_ctx = (
+            wire.make_server_ssl_context(self._tls_config)
+            if wire.tls_enabled(self._tls_config)
+            else None
+        )
+        while not self._stopping:
+            try:
+                conn, peer = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_conn,
+                args=(conn, peer, ssl_ctx),
+                name=f"fedtpu-recv-conn-{peer}",
+                daemon=True,
+            ).start()
 
-    async def _handle_conn(self, reader: asyncio.StreamReader,
-                           writer: asyncio.StreamWriter) -> None:
-        peer = writer.get_extra_info("peername")
-        self._open_writers.add(writer)
+    def _serve_conn(self, conn: socket.socket, peer, ssl_ctx) -> None:
         try:
-            while True:
+            sockio.tune_socket(conn)
+            if ssl_ctx is not None:
+                conn = ssl_ctx.wrap_socket(conn, server_side=True)
+            with self._conn_lock:
+                self._open_conns.add(conn)
+            while not self._stopping:
                 try:
-                    ftype, header, payload = await wire.read_frame(
-                        reader,
+                    ftype, header, payload = sockio.recv_frame(
+                        conn,
                         max_payload=self._config.messages_max_size_in_bytes,
                     )
-                except (asyncio.IncompleteReadError, ConnectionError):
-                    break
+                except (ConnectionError, OSError):
+                    return
                 except wire.WireError as e:
                     # Oversized/bad frame: tear the connection down before
                     # buffering anything (memory protection).
-                    logger.warning(
-                        "dropping connection from %s: %s", peer, e
-                    )
-                    break
+                    logger.warning("dropping connection from %s: %s", peer, e)
+                    return
                 if ftype != wire.FTYPE_DATA:
-                    await wire.write_frame(
-                        writer, wire.FTYPE_RESP,
-                        {"code": CODE_INTERNAL_ERROR, "msg": "expected DATA frame"},
+                    sockio.send_frame(
+                        conn, wire.FTYPE_RESP,
+                        {"code": CODE_INTERNAL_ERROR,
+                         "msg": "expected DATA frame"},
                     )
                     continue
-                # readexactly handed us a fresh buffer; the store may retain
-                # the view past this loop iteration.
                 code, msg = self._store.offer(header, payload)
-                await wire.write_frame(
-                    writer, wire.FTYPE_RESP, {"code": code, "msg": msg}
+                sockio.send_frame(
+                    conn, wire.FTYPE_RESP, {"code": code, "msg": msg}
                 )
-        except asyncio.CancelledError:
-            pass
+        except ssl.SSLError as e:
+            logger.warning("TLS handshake with %s failed: %s", peer, e)
         except Exception as e:  # noqa: BLE001 - connection-scoped failures
-            logger.warning("receiver connection from %s failed: %s", peer, e)
+            if not self._stopping:
+                logger.warning("receiver connection from %s failed: %s", peer, e)
         finally:
-            self._open_writers.discard(writer)
+            with self._conn_lock:
+                self._open_conns.discard(conn)
             try:
-                writer.close()
-            except RuntimeError:
-                pass  # loop already closing
-
+                conn.close()
+            except OSError:
+                pass

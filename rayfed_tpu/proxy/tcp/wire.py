@@ -24,7 +24,6 @@ RESP header: {code, msg}   codes per reference: 200 OK, 417 job mismatch,
 
 from __future__ import annotations
 
-import asyncio
 import ssl
 import struct
 from typing import Dict, List, Optional, Tuple
@@ -66,64 +65,6 @@ def as_byte_view(buf) -> memoryview:
     if view.format != "B" or view.ndim != 1:
         view = view.cast("B")
     return view
-
-
-async def write_frame(
-    writer: asyncio.StreamWriter,
-    ftype: int,
-    header: Dict,
-    buffers: Optional[List] = None,
-    chunk_bytes: int = 4 * 1024 * 1024,
-) -> None:
-    buffers = buffers or []
-    payload_len = sum(memoryview(b).nbytes for b in buffers)
-    writer.write(encode_prefix_and_header(ftype, header, payload_len))
-    for buf in buffers:
-        view = as_byte_view(buf)
-        # Chunked writes with periodic drain keep memory bounded on 100MB+
-        # pushes instead of buffering the whole payload in the transport.
-        for off in range(0, len(view), chunk_bytes):
-            writer.write(view[off: off + chunk_bytes])
-            await writer.drain()
-    await writer.drain()
-
-
-async def read_frame(
-    reader: asyncio.StreamReader,
-    max_payload: Optional[int] = None,
-) -> Tuple[int, Dict, memoryview]:
-    """Read one frame. Size limits are enforced *before* the payload is
-    buffered, so an oversized frame costs no memory — the connection is torn
-    down instead of answered (memory protection beats politeness; the
-    reference gets the same effect from gRPC's max_receive_message_length).
-
-    The payload lands in a fresh ``bytearray``, so array views decoded from
-    it (``np.frombuffer``) are writable — consumers may mutate in place.
-    """
-    prefix = await reader.readexactly(PREFIX_LEN)
-    magic, version, ftype, hlen, plen = _PREFIX.unpack(prefix)
-    if magic != WIRE_MAGIC:
-        raise WireError(f"bad magic {magic!r}")
-    if version != WIRE_VERSION:
-        raise WireError(f"unsupported wire version {version}")
-    if hlen > _MAX_HEADER:
-        raise WireError(f"header length {hlen} exceeds cap {_MAX_HEADER}")
-    cap = _MAX_PAYLOAD if max_payload is None else min(max_payload, _MAX_PAYLOAD)
-    if plen > cap:
-        raise WireError(f"payload length {plen} exceeds cap {cap}")
-    header = msgpack.unpackb(await reader.readexactly(hlen), raw=False)
-    if not plen:
-        return ftype, header, memoryview(b"")
-    buf = bytearray(plen)
-    view = memoryview(buf)
-    off = 0
-    while off < plen:
-        chunk = await reader.read(min(plen - off, _READ_CHUNK))
-        if not chunk:
-            raise asyncio.IncompleteReadError(bytes(view[:off]), plen)
-        view[off: off + len(chunk)] = chunk
-        off += len(chunk)
-    return ftype, header, view
 
 
 # ---------------------------------------------------------------------------
