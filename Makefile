@@ -1,0 +1,12 @@
+# Build the native fastwire extension in place (optional: the transport
+# falls back to pure-Python socket IO when the extension is absent).
+.PHONY: native test clean
+
+native:
+	python setup.py build_ext --inplace
+
+test:
+	./test.sh
+
+clean:
+	rm -rf build rayfed_tpu/_fastwire*.so

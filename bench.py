@@ -123,6 +123,12 @@ def run_transport(transport: str) -> float:
             p.start()
         for p in procs:
             p.join(timeout=600)
+        hung = [p for p in procs if p.is_alive()]
+        for p in hung:
+            p.terminate()  # a live non-daemon child would hang exit
+            p.join(timeout=30)
+        if hung:
+            raise RuntimeError(f"{transport} bench party hung; terminated")
         for p in procs:
             if p.exitcode != 0:
                 raise RuntimeError(
@@ -132,7 +138,26 @@ def run_transport(transport: str) -> float:
             return json.load(f)["gbps"]
 
 
+def _try_build_fastwire() -> None:
+    """Best-effort build of the native C++ IO lane; the transport falls
+    back to pure-Python sockets if this fails."""
+    import glob
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if glob.glob(os.path.join(here, "rayfed_tpu", "_fastwire*.so")):
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "setup.py", "build_ext", "--inplace"],
+            cwd=here, capture_output=True, timeout=120, check=False,
+        )
+    except Exception:
+        pass
+
+
 def main() -> None:
+    _try_build_fastwire()
     native = run_transport("tcp")
     baseline = run_transport("grpc")
     result = {

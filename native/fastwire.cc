@@ -1,0 +1,189 @@
+/* fastwire (C++): GIL-released socket IO for the FTP1 data plane.
+ *
+ * The role the reference delegates to native dependencies (Ray's C++ core
+ * and gRPC's C-core move its bytes; SURVEY.md C14/§2) is filled here by a
+ * small CPython extension: vectored sends (writev) of header+payload in one
+ * syscall batch and exact-length receives, both with the GIL released and
+ * poll()-based timeouts compatible with Python socket timeout semantics
+ * (Python puts timed sockets in non-blocking mode, so EAGAIN must poll).
+ *
+ * Plaintext sockets only — TLS connections stay on the Python ssl path.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <errno.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+
+#define MAX_IOV 64
+
+/* Wait for the fd to become ready; returns 0 ok, -1 timeout, errno>0 error. */
+static int wait_fd(int fd, short events, long timeout_ms) {
+    struct pollfd pfd = {fd, events, 0};
+    for (;;) {
+        int rc = poll(&pfd, 1, timeout_ms < 0 ? -1 : (int)timeout_ms);
+        if (rc > 0) return 0;
+        if (rc == 0) return -1;
+        if (errno == EINTR) continue;
+        return errno;
+    }
+}
+
+/* sendv(fd, timeout_ms, buffers_sequence) -> None
+ * Sends every buffer fully, in order, via writev. */
+static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
+    int fd;
+    long timeout_ms;
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "ilO", &fd, &timeout_ms, &seq))
+        return NULL;
+
+    PyObject *fast = PySequence_Fast(seq, "buffers must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    if (n > MAX_IOV) {
+        Py_DECREF(fast);
+        PyErr_Format(PyExc_ValueError, "too many buffers (%zd > %d)", n,
+                     MAX_IOV);
+        return NULL;
+    }
+
+    Py_buffer views[MAX_IOV];
+    struct iovec iov[MAX_IOV];
+    Py_ssize_t nviews = 0;
+    size_t total = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        if (PyObject_GetBuffer(item, &views[nviews], PyBUF_C_CONTIGUOUS) < 0) {
+            for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        iov[nviews].iov_base = views[nviews].buf;
+        iov[nviews].iov_len = (size_t)views[nviews].len;
+        total += (size_t)views[nviews].len;
+        nviews++;
+    }
+
+    int err = 0;        /* errno, or -1 for poll timeout */
+    size_t sent = 0;
+    Py_BEGIN_ALLOW_THREADS;
+    int first = 0;
+    while (sent < total) {
+        while (first < nviews && iov[first].iov_len == 0) first++;
+        ssize_t rc = writev(fd, &iov[first], (int)(nviews - first));
+        if (rc < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int w = wait_fd(fd, POLLOUT, timeout_ms);
+                if (w == 0) continue;
+                err = (w == -1) ? -1 : w;
+                break;
+            }
+            err = errno;
+            break;
+        }
+        sent += (size_t)rc;
+        size_t done = (size_t)rc;
+        while (done > 0 && first < nviews) {
+            if (done >= iov[first].iov_len) {
+                done -= iov[first].iov_len;
+                iov[first].iov_len = 0;
+                first++;
+            } else {
+                iov[first].iov_base = (char *)iov[first].iov_base + done;
+                iov[first].iov_len -= done;
+                done = 0;
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS;
+
+    for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
+    Py_DECREF(fast);
+
+    if (err == -1) {
+        PyErr_SetString(PyExc_TimeoutError, "fastwire send timed out");
+        return NULL;
+    }
+    if (err != 0) {
+        errno = err;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    Py_RETURN_NONE;
+}
+
+/* recv_exact(fd, timeout_ms, writable_buffer) -> None
+ * Fills the buffer completely or raises (ConnectionError on EOF). */
+static PyObject *fastwire_recv_exact(PyObject *self, PyObject *args) {
+    int fd;
+    long timeout_ms;
+    Py_buffer buf;
+    if (!PyArg_ParseTuple(args, "ilw*", &fd, &timeout_ms, &buf))
+        return NULL;
+
+    int err = 0;  /* errno, -1 poll timeout, -2 EOF */
+    Py_BEGIN_ALLOW_THREADS;
+    char *p = (char *)buf.buf;
+    size_t remaining = (size_t)buf.len;
+    while (remaining > 0) {
+        ssize_t rc = recv(fd, p, remaining, 0);
+        if (rc > 0) {
+            p += rc;
+            remaining -= (size_t)rc;
+            continue;
+        }
+        if (rc == 0) {
+            err = -2;
+            break;
+        }
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            int w = wait_fd(fd, POLLIN, timeout_ms);
+            if (w == 0) continue;
+            err = (w == -1) ? -1 : w;
+            break;
+        }
+        err = errno;
+        break;
+    }
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&buf);
+
+    if (err == -2) {
+        PyErr_SetString(PyExc_ConnectionError,
+                        "peer closed connection mid-frame");
+        return NULL;
+    }
+    if (err == -1) {
+        PyErr_SetString(PyExc_TimeoutError, "fastwire recv timed out");
+        return NULL;
+    }
+    if (err != 0) {
+        errno = err;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef fastwire_methods[] = {
+    {"sendv", fastwire_sendv, METH_VARARGS,
+     "sendv(fd, timeout_ms, buffers): fully send all buffers via writev."},
+    {"recv_exact", fastwire_recv_exact, METH_VARARGS,
+     "recv_exact(fd, timeout_ms, buffer): fill the writable buffer."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef fastwire_module = {
+    PyModuleDef_HEAD_INIT, "_fastwire",
+    "GIL-released vectored socket IO for the rayfed_tpu data plane.", -1,
+    fastwire_methods,
+};
+
+PyMODINIT_FUNC PyInit__fastwire(void) {
+    return PyModule_Create(&fastwire_module);
+}

@@ -33,10 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.7 exposes shard_map at the top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # requires jax >= 0.7
 
 
 def party_axis_mesh(n_parties: int, devices=None, inner_axes=("data",),

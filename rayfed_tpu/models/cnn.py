@@ -1,0 +1,72 @@
+"""Small convnet for federated image classification (CIFAR-10 shapes —
+BASELINE.json config #5). Functional JAX; NHWC layout with channel counts
+sized so XLA tiles the convs onto the MXU."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+def init_cnn(
+    rng,
+    num_classes: int = 10,
+    channels: Sequence[int] = (32, 64),
+    input_hw: int = 32,
+    in_channels: int = 3,
+    dtype=jnp.float32,
+) -> Params:
+    keys = jax.random.split(rng, len(channels) + 2)
+    convs = []
+    c_in = in_channels
+    for k, c_out in zip(keys, channels):
+        scale = (2.0 / (9 * c_in)) ** 0.5
+        convs.append(
+            {
+                "w": (jax.random.normal(k, (3, 3, c_in, c_out)) * scale).astype(dtype),
+                "b": jnp.zeros((c_out,), dtype),
+            }
+        )
+        c_in = c_out
+    # Two 2x2 pools per conv stage halve H/W each time.
+    hw = input_hw // (2 ** len(channels))
+    flat = hw * hw * c_in
+    dense_scale = (2.0 / flat) ** 0.5
+    return {
+        "convs": convs,
+        "dense": {
+            "w": (jax.random.normal(keys[-2], (flat, 128)) * dense_scale).astype(dtype),
+            "b": jnp.zeros((128,), dtype),
+        },
+        "head": {
+            "w": (jax.random.normal(keys[-1], (128, num_classes)) * 0.1).astype(dtype),
+            "b": jnp.zeros((num_classes,), dtype),
+        },
+    }
+
+
+def cnn_apply(params: Params, x) -> jax.Array:
+    """x: (B, H, W, C) -> logits (B, num_classes)."""
+    for conv in params["convs"]:
+        x = jax.lax.conv_general_dilated(
+            x, conv["w"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + conv["b"]
+        x = jax.nn.relu(x)
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["dense"]["w"] + params["dense"]["b"])
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def cnn_loss(params: Params, x, y) -> jax.Array:
+    logits = cnn_apply(params, x)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+    return (logz - gold).mean()

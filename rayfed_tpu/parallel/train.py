@@ -23,10 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.7
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # requires jax >= 0.7 (axis_names/check_vma API)
 
 from rayfed_tpu.models import transformer as tfm
 from rayfed_tpu.parallel import sharding as shd
@@ -60,7 +57,6 @@ def make_fed_train_step(
         # Sequence-parallel attention: shard_map over the seq axis with K/V
         # ring rotation; every other axis stays GSPMD-automatic.
         def attn(q, k, v):
-            other = tuple(a for a in mesh.axis_names if a != seq_axis)
             pspec = P(None, seq_axis, None, None)
             return shard_map(
                 functools.partial(ring_attention, axis_name=seq_axis),

@@ -36,14 +36,15 @@ WINDOW = 8
 
 
 class _Inflight:
-    __slots__ = ("out", "header", "buffers", "attempts", "sent_at")
+    __slots__ = ("out", "header", "buffers", "attempts", "sent_at", "fseq")
 
-    def __init__(self, out: Future, header, buffers):
+    def __init__(self, out: Future, header, buffers, fseq: int):
         self.out = out
         self.header = header
         self.buffers = buffers
         self.attempts = 0
         self.sent_at = 0.0
+        self.fseq = fseq
 
 
 class PipelinedLane:
@@ -55,16 +56,15 @@ class PipelinedLane:
         dest: str,
         connect: Callable[[Optional[int]], socket.socket],
         max_attempts: int,
-        backoff_s: Callable[[int], float],
         ack_timeout_s: float,
         on_ack: Callable[[], None],
     ):
         self._dest = dest
         self._connect = connect
         self._max_attempts = max_attempts
-        self._backoff_s = backoff_s
         self._ack_timeout_s = ack_timeout_s
         self._on_ack = on_ack
+        self._next_fseq = 0
         self._jobs: Queue = Queue()
         self._lock = threading.Lock()
         self._inflight: deque = deque()
@@ -79,7 +79,12 @@ class PipelinedLane:
         self._writer.start()
 
     def submit(self, out: Future, header, buffers) -> None:
-        self._jobs.put(_Inflight(out, header, buffers))
+        # Frames carry a per-lane sequence number which the receiver echoes
+        # in its RESP; acks are matched by it, never by position — a late
+        # ack for a timed-out/resent frame must not resolve its successor.
+        self._next_fseq += 1
+        header = dict(header, fseq=self._next_fseq)
+        self._jobs.put(_Inflight(out, header, buffers, self._next_fseq))
 
     def close(self) -> None:
         self._closed = True
@@ -115,6 +120,12 @@ class PipelinedLane:
     def _dispatch(self, job: _Inflight) -> bool:
         """Send one job (reconnecting/resending as needed). Returns False
         only when the lane is closed."""
+        if self._closed:
+            # Closed before the first attempt: this job is in neither
+            # _inflight nor _jobs, so fail it here or nobody ever will.
+            self._window.release()
+            job.out.set_exception(ConnectionError("sender stopped"))
+            return False
         while not self._closed:
             try:
                 sock = self._ensure_conn()
@@ -219,12 +230,20 @@ class PipelinedLane:
                     raise ConnectionError("peer stalled: ack overdue")
                 if ftype != wire.FTYPE_RESP:
                     raise wire.WireError(f"expected RESP, got {ftype}")
+                fseq = resp.get("fseq")
                 with self._lock:
-                    if gen != self._reader_gen:
-                        return  # superseded by a reconnect
-                    if not self._inflight:
-                        raise wire.WireError("ack with no frame in flight")
-                    job = self._inflight.popleft()
+                    if gen != self._reader_gen and not self._inflight:
+                        return  # superseded by a reconnect, nothing to ack
+                    job = None
+                    for candidate in self._inflight:
+                        if candidate.fseq == fseq:
+                            job = candidate
+                            break
+                    if job is None:
+                        # Ack for a frame we already timed out / resent and
+                        # matched elsewhere — drop it.
+                        continue
+                    self._inflight.remove(job)
                 self._window.release()
                 code = resp.get("code")
                 if code == CODE_OK:

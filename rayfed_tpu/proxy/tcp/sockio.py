@@ -11,13 +11,29 @@ through StreamReader). Frame layout is defined in
 from __future__ import annotations
 
 import socket
+import ssl
 from typing import Dict, List, Optional, Tuple
 
 import msgpack
 
 from rayfed_tpu.proxy.tcp import wire
 
+try:  # native C++ lane (build with `make native`); Python IO is the fallback
+    from rayfed_tpu import _fastwire
+except ImportError:  # pragma: no cover - environment-dependent
+    _fastwire = None
+
 _SOCK_BUF = 8 * 1024 * 1024
+
+
+def _native_ok(sock) -> bool:
+    # The fastwire path works on raw fds only; TLS stays on the ssl module.
+    return _fastwire is not None and not isinstance(sock, ssl.SSLSocket)
+
+
+def _timeout_ms(sock: socket.socket) -> int:
+    t = sock.gettimeout()
+    return -1 if t is None else int(t * 1000)
 
 
 def tune_socket(sock: socket.socket) -> None:
@@ -33,14 +49,27 @@ def send_frame(sock: socket.socket, ftype: int, header: Dict,
                buffers: Optional[List] = None) -> None:
     buffers = buffers or []
     payload_len = sum(memoryview(b).nbytes for b in buffers)
-    sock.sendall(wire.encode_prefix_and_header(ftype, header, payload_len))
-    for buf in buffers:
-        view = wire.as_byte_view(buf)
-        if view.nbytes:
-            sock.sendall(view)
+    prefix = wire.encode_prefix_and_header(ftype, header, payload_len)
+    views = [wire.as_byte_view(b) for b in buffers]
+    views = [v for v in views if v.nbytes]
+    if _native_ok(sock) and len(views) < 63:
+        try:
+            _fastwire.sendv(sock.fileno(), _timeout_ms(sock), [prefix] + views)
+            return
+        except TimeoutError:
+            raise socket.timeout("fastwire send timed out") from None
+    sock.sendall(prefix)
+    for view in views:
+        sock.sendall(view)
 
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    if _native_ok(sock):
+        try:
+            _fastwire.recv_exact(sock.fileno(), _timeout_ms(sock), view)
+            return
+        except TimeoutError:
+            raise socket.timeout("fastwire recv timed out") from None
     got = 0
     total = view.nbytes
     while got < total:

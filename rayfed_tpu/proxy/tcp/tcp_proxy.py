@@ -70,13 +70,6 @@ class _DestWorker(threading.Thread):
 
             policy = proxy._config.get_retry_policy()
 
-            def backoff_s(attempt: int) -> float:
-                return min(
-                    (policy.initial_backoff_ms / 1000)
-                    * policy.backoff_multiplier**attempt,
-                    policy.max_backoff_ms / 1000,
-                )
-
             def bump_acks() -> None:
                 proxy._bump_stat("send_op_count")
 
@@ -84,7 +77,6 @@ class _DestWorker(threading.Thread):
                 dest_party,
                 connect=lambda attempts: self._fresh_sock(attempts),
                 max_attempts=policy.max_attempts,
-                backoff_s=backoff_s,
                 ack_timeout_s=proxy._config.timeout_in_ms / 1000,
                 on_ack=bump_acks,
             )
@@ -446,8 +438,11 @@ class TcpReceiverProxy(ReceiverProxy):
                     )
                     continue
                 code, msg = self._store.offer(header, payload)
+                # Echo the sender's frame sequence number: pipelined acks
+                # are matched by fseq, never by position.
                 sockio.send_frame(
-                    conn, wire.FTYPE_RESP, {"code": code, "msg": msg}
+                    conn, wire.FTYPE_RESP,
+                    {"code": code, "msg": msg, "fseq": header.get("fseq")},
                 )
         except ssl.SSLError as e:
             logger.warning("TLS handshake with %s failed: %s", peer, e)

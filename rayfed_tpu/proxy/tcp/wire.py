@@ -43,10 +43,8 @@ FTYPE_RESP = 1
 _MAX_PAYLOAD = 1 << 40
 # Headers are tiny msgpack dicts; anything near this is an attack or a bug.
 _MAX_HEADER = 64 * 1024 * 1024
-# Response frames carry only {code, msg}.
+# Response frames carry only {code, msg, fseq}.
 MAX_RESP_FRAME = 1 << 20
-
-_READ_CHUNK = 8 * 1024 * 1024
 
 
 class WireError(Exception):

@@ -1,0 +1,42 @@
+"""Packaging (capability parity: reference ``setup.py``)."""
+
+import os
+
+from setuptools import Extension, find_packages, setup
+
+# Optional native fastwire extension (C++ via the CPython C API, no
+# pybind11); the Python transport is the fallback when it is unavailable.
+ext_modules = []
+fastwire_src = os.path.join("native", "fastwire.cc")
+if os.path.exists(fastwire_src):
+    ext_modules.append(
+        Extension(
+            "rayfed_tpu._fastwire",
+            sources=[fastwire_src],
+            extra_compile_args=["-O3", "-std=c++17"],
+        )
+    )
+
+setup(
+    name="rayfed-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native multi-party federated execution framework: "
+        "multi-controller programming model, owner-push data perimeter, "
+        "party device meshes, collective FedAvg."
+    ),
+    packages=find_packages(include=["rayfed_tpu", "rayfed_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "numpy",
+        "msgpack",
+        "cloudpickle",
+        "cryptography",
+    ],
+    extras_require={
+        "tpu": ["jax", "optax"],
+        "grpc": ["grpcio"],
+        "test": ["pytest"],
+    },
+    ext_modules=ext_modules,
+)

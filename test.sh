@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Test runner (capability parity: reference test.sh — cert generation then
+# the pytest suite; our tests generate certs per-test via tmp_path, and the
+# suite is process-isolated per party by construction, so one pytest run
+# suffices).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,159 @@
+"""Failure-semantics tests (mirror of ref
+``fed/tests/test_cross_silo_error.py`` and
+``test_exit_on_failure_sending.py``): exit_on_sending_failure makes the
+party exit non-zero, the sending_failure_handler observes the error, and a
+never-started peer produces a bounded failure instead of an infinite hang."""
+
+import multiprocessing
+
+import pytest
+
+import rayfed_tpu as fed
+from tests.utils import FAST_COMM_CONFIG, MP, get_addresses, run_parties
+
+
+@fed.remote
+def boom():
+    raise ValueError("intentional failure")
+
+
+@fed.remote
+def consume(x):
+    return x
+
+
+def run_exit_on_sending_failure(party, addresses):
+    # Mirrors ref test_cross_silo_error.py:268-308: the producing party's
+    # failed push triggers exit(1) via SIGINT-driven unintended shutdown.
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": {
+                **FAST_COMM_CONFIG,
+                "exit_on_sending_failure": True,
+            }
+        },
+    )
+    bad = boom.party("alice").remote()
+    out = consume.party("bob").remote(bad)
+    try:
+        fed.get(out)
+    except fed.FedRemoteError:
+        pass
+    fed.shutdown()
+
+
+def test_exit_on_sending_failure_exits_nonzero():
+    addresses = get_addresses(["alice", "bob"])
+    procs = {
+        p: MP.Process(target=run_exit_on_sending_failure, args=(p, addresses))
+        for p in ("alice", "bob")
+    }
+    for p in procs.values():
+        p.start()
+    for p in procs.values():
+        p.join(timeout=120)
+    # Alice's push of `bad` fails (producer raised); with
+    # exit_on_sending_failure it must exit 1. Bob receives the error
+    # envelope, re-raises as FedRemoteError, catches it, exits 0.
+    assert procs["alice"].exitcode == 1, procs["alice"].exitcode
+    assert procs["bob"].exitcode == 0, procs["bob"].exitcode
+
+
+def run_failure_handler(party, addresses, q):
+    def handler(err):
+        q.put(repr(err))
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": {
+                **FAST_COMM_CONFIG,
+                "exit_on_sending_failure": True,
+            }
+        },
+        sending_failure_handler=handler,
+    )
+    bad = boom.party("alice").remote()
+    consume.party("bob").remote(bad)
+    import time
+
+    time.sleep(30)  # the SIGINT from the drain thread interrupts this
+    fed.shutdown()
+
+
+def test_sending_failure_handler_fires():
+    # Mirrors ref test_exit_on_failure_sending.py:38-84 (handler observed
+    # via a multiprocessing queue; process exits 1 instead of hanging).
+    addresses = get_addresses(["alice", "bob"])
+    q = multiprocessing.get_context("spawn").Queue()
+    alice = MP.Process(target=run_failure_handler, args=("alice", addresses, q))
+    bob = MP.Process(target=run_failure_handler, args=("bob", addresses, q))
+    alice.start()
+    bob.start()
+    alice.join(timeout=120)
+    got = q.get(timeout=10)
+    assert "FedLocalError" in got or "intentional failure" in got, got
+    assert alice.exitcode == 1, alice.exitcode
+    bob.terminate()
+    bob.join(timeout=30)
+
+
+def run_peer_never_starts(party, addresses, q):
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": {
+                "retry_policy": {
+                    "max_attempts": 3,
+                    "initial_backoff_ms": 100,
+                    "max_backoff_ms": 300,
+                },
+                "timeout_in_ms": 5000,
+                "exit_on_sending_failure": True,
+            }
+        },
+        sending_failure_handler=lambda e: q.put(type(e).__name__),
+    )
+
+    @fed.remote
+    def produce():
+        return 42
+
+    v = produce.party("alice").remote()
+    consume.party("bob").remote(v)  # bob never starts -> send must fail
+    import time
+
+    time.sleep(60)
+    fed.shutdown()
+
+
+def test_send_failure_when_peer_never_starts():
+    addresses = get_addresses(["alice", "bob"])
+    q = multiprocessing.get_context("spawn").Queue()
+    alice = MP.Process(target=run_peer_never_starts, args=("alice", addresses, q))
+    alice.start()
+    alice.join(timeout=120)
+    assert alice.exitcode == 1, alice.exitcode
+    assert q.get(timeout=10) == "ConnectionError"
+
+
+def run_barrier(party, addresses):
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": dict(FAST_COMM_CONFIG),
+            "barrier_on_initializing": True,
+        },
+    )
+    # Barrier passed -> both receivers were reachable before any task ran
+    # (ref fed/tests/test_ping_others.py).
+    fed.shutdown()
+
+
+def test_ping_others_barrier():
+    run_parties(run_barrier, ["alice", "bob"])

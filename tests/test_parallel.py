@@ -12,10 +12,7 @@ from rayfed_tpu.parallel import sharding as shd
 from rayfed_tpu.parallel.ring import ring_attention
 from rayfed_tpu.parallel.train import make_fed_train_step
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def seq_mesh(n=8):

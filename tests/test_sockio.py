@@ -1,0 +1,83 @@
+"""Frame IO unit tests over socketpair: native fastwire lane (when built)
+and the pure-Python fallback must be wire-compatible."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from rayfed_tpu.proxy.tcp import sockio, wire
+
+
+def roundtrip_frame(header, buffers, max_payload=None, force_python=False):
+    a, b = socket.socketpair()
+    result = {}
+
+    def reader():
+        result["frame"] = sockio.recv_frame(b, max_payload=max_payload)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    old = sockio._fastwire
+    if force_python:
+        sockio._fastwire = None
+    try:
+        sockio.send_frame(a, wire.FTYPE_DATA, header, buffers)
+    finally:
+        sockio._fastwire = old
+    t.join(timeout=10)
+    a.close()
+    b.close()
+    return result["frame"]
+
+
+@pytest.mark.parametrize("force_python", [False, True])
+def test_frame_roundtrip(force_python):
+    header = {"job": "j", "up": "1#0", "down": "2", "pkind": "tree",
+              "pmeta": b"\x80", "is_error": False, "src": "alice"}
+    payload = np.arange(1000, dtype=np.float64)
+    ftype, got_header, got_payload = roundtrip_frame(
+        header, [payload], force_python=force_python
+    )
+    assert ftype == wire.FTYPE_DATA
+    assert got_header == header
+    np.testing.assert_array_equal(
+        np.frombuffer(got_payload, np.float64), payload
+    )
+    # Received payloads must be writable (consumers may mutate in place).
+    arr = np.frombuffer(got_payload, np.float64)
+    arr[0] = -1.0
+
+
+def test_empty_payload():
+    ftype, header, payload = roundtrip_frame({"code": 200, "msg": "ok"}, [])
+    assert payload.nbytes == 0
+
+
+def test_oversized_frame_rejected_before_buffering():
+    a, b = socket.socketpair()
+    # Hand-craft a prefix claiming a 1GB payload with a 1MB cap.
+    a.sendall(wire.encode_prefix_and_header(wire.FTYPE_DATA, {}, 1 << 30))
+    with pytest.raises(wire.WireError, match="exceeds cap"):
+        sockio.recv_frame(b, max_payload=1 << 20)
+    a.close()
+    b.close()
+
+
+def test_multi_buffer_send():
+    bufs = [np.ones(10, np.float32), b"tail-bytes", np.zeros(3, np.int64)]
+    ftype, header, payload = roundtrip_frame({"k": 1}, bufs)
+    total = sum(memoryview(wire.as_byte_view(x)).nbytes for x in bufs)
+    assert payload.nbytes == total
+
+
+@pytest.mark.skipif(sockio._fastwire is None, reason="fastwire not built")
+def test_fastwire_timeout():
+    a, b = socket.socketpair()
+    b.settimeout(0.2)
+    buf = bytearray(10)
+    with pytest.raises((socket.timeout, TimeoutError)):
+        sockio._recv_exact_into(b, memoryview(buf))
+    a.close()
+    b.close()

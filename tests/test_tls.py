@@ -1,0 +1,88 @@
+"""Mutual-TLS end-to-end (mirror of ref
+``fed/tests/test_enable_tls_across_parties.py``): both parties present
+CA-signed certs; data crosses encrypted; a cert-less client is rejected."""
+
+import numpy as np
+import pytest
+
+import rayfed_tpu as fed
+from tests.utils import FAST_COMM_CONFIG, get_addresses, run_parties
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.generate_tls_certs import generate, tls_config_for  # noqa: E402
+
+
+@fed.remote
+def produce(v):
+    return np.full((1024,), v, dtype=np.float32)
+
+
+@fed.remote
+def agg(a, b):
+    return float((a + b).sum())
+
+
+def run_tls(party, addresses, cert_dir):
+    fed.init(
+        addresses=addresses,
+        party=party,
+        tls_config=tls_config_for(cert_dir, party),
+        config={"cross_silo_comm": dict(FAST_COMM_CONFIG)},
+    )
+    a = produce.party("alice").remote(1.0)
+    b = produce.party("bob").remote(2.0)
+    out = agg.party("bob").remote(a, b)
+    assert fed.get(out) == 3.0 * 1024
+    fed.shutdown()
+
+
+def test_tls_two_party(tmp_path):
+    cert_dir = str(tmp_path / "certs")
+    generate(cert_dir, ["alice", "bob"])
+    run_parties(run_tls, ["alice", "bob"], extra_args=(cert_dir,), timeout=180)
+
+
+def test_certless_client_rejected(tmp_path):
+    """A TLS server must refuse a plaintext/cert-less peer."""
+    import socket
+    import ssl
+    import threading
+
+    cert_dir = str(tmp_path / "certs")
+    generate(cert_dir, ["alice", "bob"])
+    from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy
+
+    addr = get_addresses(["alice"])["alice"]
+    rp = TcpReceiverProxy(
+        addr, "alice", "job", tls_config_for(cert_dir, "alice"), {}
+    )
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    host, port = addr.rsplit(":", 1)
+
+    # Plaintext probe: server should drop it without crashing.
+    s = socket.create_connection((host, int(port)), timeout=5)
+    s.sendall(b"GARBAGE-NOT-TLS")
+    s.close()
+
+    # TLS probe without a client cert: handshake must fail.
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    raw = socket.create_connection((host, int(port)), timeout=5)
+    tls = ctx.wrap_socket(raw)
+    # Under TLS 1.3 the client-cert rejection surfaces on the first read
+    # (the server sends an alert and closes) rather than during wrap.
+    rejected = False
+    try:
+        tls.sendall(b"x" * 64)
+        rejected = tls.recv(1) == b""
+    except (ssl.SSLError, ConnectionError, OSError):
+        rejected = True
+    assert rejected, "server accepted a cert-less TLS client"
+    tls.close()
+    rp.stop()

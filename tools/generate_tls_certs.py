@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Self-signed CA + per-party certificate generator for tests/demos.
+
+Capability parity: reference ``tool/generate_tls_certs.py`` (129 LoC,
+openssl-subprocess based). This version uses the ``cryptography`` package
+directly so it runs anywhere the framework does.
+
+Usage:
+    python tools/generate_tls_certs.py OUTPUT_DIR [party ...]
+
+Writes ``ca.crt`` plus ``<party>/{cert.pem,key.pem}`` per party (default
+parties: alice, bob). Every party cert is signed by the same CA, matching
+the mutual-TLS trust model of ``fed.init(tls_config={ca_cert, cert, key})``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import sys
+
+
+def generate(output_dir: str, parties) -> None:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(output_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    ca_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "rayfed-tpu-test-ca")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(days=1))
+        .not_valid_after(now + datetime.timedelta(days=365))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    with open(os.path.join(output_dir, "ca.crt"), "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+
+    for party in parties:
+        pdir = os.path.join(output_dir, party)
+        os.makedirs(pdir, exist_ok=True)
+        key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        subject = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, party)]
+        )
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject)
+            .issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .add_extension(
+                x509.SubjectAlternativeName(
+                    [
+                        x509.DNSName("localhost"),
+                        x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                    ]
+                ),
+                critical=False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+        with open(os.path.join(pdir, "key.pem"), "wb") as f:
+            f.write(
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.TraditionalOpenSSL,
+                    serialization.NoEncryption(),
+                )
+            )
+        with open(os.path.join(pdir, "cert.pem"), "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+
+def tls_config_for(output_dir: str, party: str) -> dict:
+    """The ``fed.init(tls_config=...)`` dict for a generated party."""
+    return {
+        "ca_cert": os.path.join(output_dir, "ca.crt"),
+        "cert": os.path.join(output_dir, party, "cert.pem"),
+        "key": os.path.join(output_dir, party, "key.pem"),
+    }
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/rayfed_tpu_certs"
+    parties = sys.argv[2:] or ["alice", "bob"]
+    generate(out, parties)
+    print(f"wrote CA + {len(parties)} party certs under {out}")
