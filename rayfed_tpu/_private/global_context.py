@@ -62,6 +62,12 @@ class GlobalContext:
             self._seq_count += 1
             return self._seq_count
 
+    def peek_seq_id(self) -> int:
+        """Current DAG position WITHOUT advancing it — advancing outside a
+        call site would desynchronize this party from its peers."""
+        with self._seq_lock:
+            return self._seq_count
+
     # -- cleanup / failure bookkeeping ------------------------------------
     def get_cleanup_manager(self):
         return self._cleanup_manager

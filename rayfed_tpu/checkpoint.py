@@ -1,0 +1,101 @@
+"""Party-local checkpoint / resume.
+
+The reference has NO checkpointing (SURVEY.md §5.4); job-level restart is
+only feasible there because seq ids are deterministic across re-runs. This
+module supplies the missing piece for long federated training: each party
+snapshots its local state (model/optimizer pytrees of — possibly sharded —
+jax Arrays, plus the engine's seq-id counter) with orbax, and on restart
+every party restores its own snapshot and replays the driver program; the
+deterministic DAG numbering then lines the parties back up without any
+cross-party coordination.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from rayfed_tpu._private.global_context import get_global_context
+
+_META_FILE = "fed_meta.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_party_state(path: str, state: Any, step: int = 0) -> None:
+    """Snapshot ``state`` (a pytree of arrays) plus engine metadata.
+
+    ``path`` is a directory; one snapshot per path (use step-suffixed paths
+    or a CheckpointManager for retention policies).
+    """
+    path = os.path.abspath(path)
+    ctx = get_global_context()
+    meta = {
+        "step": step,
+        "party": ctx.get_current_party() if ctx else None,
+        "job": ctx.get_job_name() if ctx else None,
+        # Snapshot of the deterministic DAG position: informational — on
+        # restart the driver replays from the top and re-derives ids.
+        # (peek, never next: advancing the counter here would desync this
+        # party's rendezvous keys from its peers'.)
+        "seq_id_watermark": ctx.peek_seq_id() if ctx else None,
+    }
+    ckpt = _checkpointer()
+    ckpt.save(os.path.join(path, "state"), state, force=True)
+    # StandardCheckpointer commits asynchronously; the snapshot is only
+    # durable (and the meta file only truthful) after the barrier.
+    ckpt.wait_until_finished()
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f)
+
+
+def restore_party_state(path: str, template: Optional[Any] = None) -> Any:
+    """Restore a snapshot. ``template`` (a pytree of arrays or
+    ShapeDtypeStructs with shardings) restores leaves onto the same
+    shardings/devices; without it, arrays restore to host."""
+    path = os.path.abspath(path)
+    state_path = os.path.join(path, "state")
+    ckpt = _checkpointer()
+    if template is not None:
+        import jax
+        import orbax.checkpoint as ocp
+
+        targets = jax.tree_util.tree_map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x)
+            if hasattr(x, "shape")
+            else x,
+            template,
+        )
+        return ckpt.restore(state_path, targets)
+    return ckpt.restore(state_path)
+
+
+def load_meta(path: str) -> dict:
+    with open(os.path.join(os.path.abspath(path), _META_FILE)) as f:
+        return json.load(f)
+
+
+def latest_step(base_dir: str) -> Optional[int]:
+    """Scan ``base_dir`` for step-suffixed snapshot dirs (``step_<N>``) and
+    return the newest complete one."""
+    if not os.path.isdir(base_dir):
+        return None
+    steps = []
+    for name in os.listdir(base_dir):
+        if name.startswith("step_"):
+            full = os.path.join(base_dir, name)
+            if os.path.exists(os.path.join(full, _META_FILE)):
+                try:
+                    steps.append(int(name[5:]))
+                except ValueError:
+                    continue
+    return max(steps) if steps else None
+
+
+def step_dir(base_dir: str, step: int) -> str:
+    return os.path.join(base_dir, f"step_{step}")

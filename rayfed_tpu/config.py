@@ -92,6 +92,12 @@ class CrossSiloMessageConfig:
 
     Attributes:
         timeout_in_ms: per-send timeout (ref default 60000, config.py:126).
+        recv_timeout_in_ms: optional deadline for cross-party receives;
+            None (default) waits forever like the reference. Set it so a
+            pure-receiver party fails fast with TimeoutError when a peer
+            vanishes before pushing (no error envelope can cross a dead
+            transport — improvement over the reference, which can only
+            hang in that case).
         messages_max_size_in_bytes: max payload size; None = unlimited
             (the reference caps gRPC at 500MB, grpc_options.py:28-29).
         serializing_allowed_list: {module: [class, ...]} whitelist for
@@ -104,6 +110,7 @@ class CrossSiloMessageConfig:
     """
 
     timeout_in_ms: int = 60000
+    recv_timeout_in_ms: Optional[int] = None
     messages_max_size_in_bytes: Optional[int] = None
     serializing_allowed_list: Optional[Dict[str, List[str]]] = None
     exit_on_sending_failure: Optional[bool] = False

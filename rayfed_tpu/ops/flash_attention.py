@@ -1,0 +1,140 @@
+"""Pallas TPU flash attention (causal, forward).
+
+Blockwise attention with an online softmax: each q-block streams through
+the k/v blocks at or below its diagonal, keeping the running max and
+normalizer in registers, so the S x S score matrix never materializes in
+HBM — O(S) memory instead of O(S^2), with the block matmuls sized for the
+MXU (128-lane tiles, f32 accumulation via ``preferred_element_type``).
+
+On non-TPU backends the same kernel runs in interpret mode (tests), and
+:func:`make_flash_attn_fn` plugs it into the transformer's ``attn_fn`` seam
+(``models/transformer.layer_fn``), composing with the ring-attention lane:
+ring handles the cross-device sequence axis, this kernel the on-device
+blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_BIG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                  scale: float, seq_len: int, q_offset_base: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+    d = q.shape[-1]
+
+    q_pos = (
+        q_offset_base + qi * block_q
+        + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    )
+
+    # Causal: only k/v blocks at or below this q block's last row.
+    last_q_pos = q_offset_base + qi * block_q + block_q - 1
+    n_kb = jax.lax.min(
+        (last_q_pos // block_k) + 1,
+        jnp.int32(seq_len // block_k),
+    )
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kblk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        k_pos = (
+            kb * block_k
+            + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vblk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_q", "block_k", "interpret", "q_offset"),
+)
+def flash_attention(
+    q, k, v,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    q_offset: int = 0,
+):
+    """Causal flash attention on (B, S, H, D) tensors.
+
+    ``q_offset`` shifts query positions (sequence-parallel callers pass the
+    shard's global offset). Sequence length must be divisible by the block
+    sizes (pad upstream); block sizes auto-shrink for short sequences.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = d**-0.5
+
+    # Fold batch and heads into one leading grid axis: (B*H, S, D).
+    def fold(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        scale=scale,
+        seq_len=sk,
+        q_offset_base=q_offset,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+
+
+def make_flash_attn_fn(block_q: int = 128, block_k: int = 128):
+    """An ``attn_fn`` for ``models.transformer.forward``: (B, S, H, D)
+    q/k/v -> (B, S, H, D), causal."""
+
+    def attn(q, k, v):
+        return flash_attention(q, k, v, block_q=block_q, block_k=block_k)
+
+    return attn

@@ -187,9 +187,11 @@ class GrpcReceiverProxy(ReceiverProxy):
         def decode(header, payload):
             return restricted_loads(bytes(payload), allowed)
 
+        recv_timeout = self._config.recv_timeout_in_ms
         self._store = RendezvousStore(
             job_name, decode,
             max_payload_bytes=self._config.messages_max_size_in_bytes,
+            recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
         )
         self._server: Optional[grpc.Server] = None
         self._ready_result = None

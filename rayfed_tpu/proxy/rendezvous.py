@@ -17,6 +17,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
+from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
 from rayfed_tpu._private.constants import (
     CODE_INTERNAL_ERROR,
@@ -46,10 +47,16 @@ class RendezvousStore:
         decode_fn: DecodeFn,
         max_payload_bytes: Optional[int] = None,
         decode_workers: int = 2,
+        recv_timeout_s: Optional[float] = None,
     ) -> None:
         self._job_name = job_name
         self._decode_fn = decode_fn
         self._max_payload_bytes = max_payload_bytes
+        # <=0 means "no deadline" (common config convention); guards the
+        # expire thread against a zero-sleep busy spin too.
+        if recv_timeout_s is not None and recv_timeout_s <= 0:
+            recv_timeout_s = None
+        self._recv_timeout_s = recv_timeout_s
         self._lock = threading.Lock()
         self._arrived: Dict[Tuple[str, str], Tuple[Dict, memoryview]] = {}
         self._waiters: Dict[Tuple[str, str], Future] = {}
@@ -62,6 +69,44 @@ class RendezvousStore:
             max_workers=decode_workers, thread_name_prefix="fedtpu-recv-decode"
         )
         self._stats = {"receive_op_count": 0}
+        self._stopped = False
+        self._deadlines: Dict[Tuple[str, str], float] = {}
+        if recv_timeout_s is not None:
+            threading.Thread(
+                target=self._expire_loop,
+                name="fedtpu-recv-deadline",
+                daemon=True,
+            ).start()
+
+    def _expire_loop(self) -> None:
+        """Fail waiters whose deadline passed — a vanished peer cannot send
+        an error envelope, so without this a pure receiver waits forever
+        (the reference behavior; opt-in via recv_timeout_in_ms)."""
+        import time
+
+        interval = max(0.05, min(1.0, self._recv_timeout_s / 4))
+        while not self._stopped:
+            time.sleep(interval)
+            now = time.monotonic()
+            expired = []
+            with self._lock:
+                for key, deadline in list(self._deadlines.items()):
+                    if now >= deadline:
+                        self._deadlines.pop(key, None)
+                        waiter = self._waiters.pop(key, None)
+                        if waiter is not None:
+                            # Tombstone: a slow (not dead) peer's frame
+                            # arriving after expiry must be acked-and-
+                            # dropped like a duplicate, not parked forever.
+                            self._mark_consumed(key)
+                            expired.append((key, waiter))
+            for key, waiter in expired:
+                waiter.set_exception(
+                    TimeoutError(
+                        f"no data arrived for rendezvous {key} within "
+                        f"{self._recv_timeout_s}s (recv_timeout_in_ms)"
+                    )
+                )
 
     # -- transport side ----------------------------------------------------
 
@@ -90,15 +135,24 @@ class RendezvousStore:
             self._stats["receive_op_count"] += 1
             if key in self._consumed:
                 # Duplicate of an already-delivered frame (ack-lost resend):
-                # acknowledge and drop.
+                # acknowledge and drop. Not traced — it carried no new data.
                 return CODE_OK, "duplicate"
             waiter = self._waiters.pop(key, None)
+            self._deadlines.pop(key, None)
             if waiter is None:
                 # An error envelope substituting already-arrived data
                 # overwrites the slot (sender reuses the same seq ids).
                 self._arrived[key] = (header, payload)
             else:
                 self._mark_consumed(key)
+        if tracing.is_enabled():
+            import time
+
+            tracing.record(
+                "recv", header.get("src", ""), header["up"], header["down"],
+                memoryview(payload).nbytes if payload is not None else 0,
+                time.perf_counter(),
+            )
         if waiter is not None:
             self._pool.submit(self._decode_into, header, payload, waiter)
         return CODE_OK, "ok"
@@ -120,13 +174,24 @@ class RendezvousStore:
                 self._mark_consumed(key)
             else:
                 self._waiters[key] = out
+                if self._recv_timeout_s is not None:
+                    import time
+
+                    self._deadlines[key] = (
+                        time.monotonic() + self._recv_timeout_s
+                    )
                 return out
         self._pool.submit(self._decode_into, header, payload, out)
         return out
 
     def _decode_into(self, header: Dict, payload, out: Future) -> None:
         try:
-            value = self._decode_fn(header, payload)
+            with tracing.span(
+                "decode", header.get("src", ""), header["up"],
+                header["down"],
+                memoryview(payload).nbytes if payload is not None else 0,
+            ):
+                value = self._decode_fn(header, payload)
         except BaseException as e:  # noqa: BLE001 - surfaced to consumer
             out.set_exception(e)
             return
@@ -137,4 +202,5 @@ class RendezvousStore:
             return dict(self._stats)
 
     def shutdown(self) -> None:
+        self._stopped = True
         self._pool.shutdown(wait=False)

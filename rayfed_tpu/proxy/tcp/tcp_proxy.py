@@ -32,6 +32,7 @@ from concurrent.futures import Future
 from queue import Queue
 from typing import Dict, Optional, Tuple
 
+from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
 from rayfed_tpu._private.constants import CODE_INTERNAL_ERROR, CODE_OK
 from rayfed_tpu.config import TcpCrossSiloMessageConfig
@@ -170,12 +171,22 @@ class _DestWorker(threading.Thread):
                 return
             out, data, upstream_seq_id, downstream_seq_id, is_error = job
             try:
-                header, buffers = self._prepare(
+                header, buffers, payload_len = self._prepare(
                     data, upstream_seq_id, downstream_seq_id, is_error
                 )
             except BaseException as e:  # noqa: BLE001 - routed to drain
                 out.set_exception(e)
                 continue
+            if tracing.is_enabled():
+                t0 = time.perf_counter()
+                nbytes = payload_len
+                out.add_done_callback(
+                    lambda f, t0=t0, nbytes=nbytes, up=upstream_seq_id,
+                    down=downstream_seq_id: tracing.record(
+                        "send", self._dest, up, down, nbytes, t0,
+                        ok=f.exception() is None,
+                    )
+                )
             if self._lane is not None:
                 self._lane.submit(out, header, buffers)
                 continue
@@ -217,7 +228,7 @@ class _DestWorker(threading.Thread):
             "pkind": kind,
             "pmeta": meta,
         }
-        return header, buffers
+        return header, buffers, payload_len
 
     def _send_half_duplex(self, header, buffers) -> bool:
         # TLS path. Send with bounded reconnect: first attempt gets the
@@ -320,10 +331,12 @@ class TcpReceiverProxy(ReceiverProxy):
     def __init__(self, listen_addr, party, job_name, tls_config, proxy_config=None):
         super().__init__(listen_addr, party, job_name, tls_config, proxy_config)
         self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
+        recv_timeout = self._config.recv_timeout_in_ms
         self._store = RendezvousStore(
             job_name,
             self._make_decode_fn(),
             max_payload_bytes=self._config.messages_max_size_in_bytes,
+            recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
         )
         self._listener: Optional[socket.socket] = None
         self._ready_result = None

@@ -1,0 +1,172 @@
+"""Lightweight tracing/profiling for cross-party transfers and tasks.
+
+The reference has NO tracing (SURVEY.md §5.1 — only per-proxy op counters).
+This module adds per-transfer spans: every send and receive records
+(kind, peer, seq ids, bytes, duration) into a bounded in-process ring,
+queryable via :func:`get_spans` / :func:`summary`, plus optional forwarding
+into ``jax.profiler.TraceAnnotation`` so transfers line up with device
+timelines in a profiler capture.
+
+Zero overhead when disabled (module-level flag checked before any work).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+_enabled = False
+_use_jax_annotations = False
+_lock = threading.Lock()
+_MAX_SPANS = 10000
+_spans: Deque["Span"] = deque(maxlen=_MAX_SPANS)
+
+
+@dataclass
+class Span:
+    kind: str                 # "send" | "recv" | "decode" | "task"
+    peer: str                 # destination or source party ("" if n/a)
+    upstream_seq_id: str
+    downstream_seq_id: str
+    nbytes: int
+    start_s: float
+    duration_s: float
+    ok: bool = True
+    extra: Dict = field(default_factory=dict)
+
+
+def enable(jax_annotations: bool = False) -> None:
+    """Turn span recording on. ``jax_annotations=True`` additionally wraps
+    spans in ``jax.profiler.TraceAnnotation`` (requires jax)."""
+    global _enabled, _use_jax_annotations
+    _enabled = True
+    _use_jax_annotations = jax_annotations
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def get_spans(kind: Optional[str] = None) -> List[Span]:
+    with _lock:
+        spans = list(_spans)
+    if kind is not None:
+        spans = [s for s in spans if s.kind == kind]
+    return spans
+
+
+# Kinds whose spans bracket the full operation (duration is meaningful);
+# "recv" spans are arrival events with no duration — no throughput for them.
+_TIMED_KINDS = {"send", "decode", "task"}
+
+
+def summary() -> Dict[str, Dict]:
+    """Aggregate per kind: count, bytes, total duration, GB/s (timed kinds
+    only — event kinds like 'recv' have no meaningful duration)."""
+    out: Dict[str, Dict] = {}
+    for s in get_spans():
+        agg = out.setdefault(
+            s.kind,
+            {"count": 0, "bytes": 0, "seconds": 0.0, "errors": 0},
+        )
+        agg["count"] += 1
+        agg["bytes"] += s.nbytes
+        agg["seconds"] += s.duration_s
+        if not s.ok:
+            agg["errors"] += 1
+    for kind, agg in out.items():
+        if kind in _TIMED_KINDS and agg["seconds"] > 1e-9:
+            agg["gbps"] = agg["bytes"] / (1 << 30) / agg["seconds"]
+    return out
+
+
+def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
+           nbytes: int, start_s: float, ok: bool = True) -> None:
+    """Directly append a span (for async paths where a context manager
+    cannot bracket the operation — e.g. pipelined sends resolved by ack)."""
+    if not _enabled:
+        return
+    with _lock:
+        _spans.append(
+            Span(
+                kind=kind,
+                peer=peer,
+                upstream_seq_id=str(upstream_seq_id),
+                downstream_seq_id=str(downstream_seq_id),
+                nbytes=nbytes,
+                start_s=start_s,
+                duration_s=time.perf_counter() - start_s,
+                ok=ok,
+            )
+        )
+
+
+class span:
+    """Context manager recording one span (no-op when tracing is off)."""
+
+    __slots__ = ("_kind", "_peer", "_up", "_down", "_nbytes", "_t0",
+                 "_jax_ctx", "_active")
+
+    def __init__(self, kind: str, peer: str = "", upstream_seq_id: str = "",
+                 downstream_seq_id: str = "", nbytes: int = 0):
+        self._kind = kind
+        self._peer = peer
+        self._up = upstream_seq_id
+        self._down = downstream_seq_id
+        self._nbytes = nbytes
+        self._jax_ctx = None
+        # Latched at __enter__: a toggle of the global flag mid-span must
+        # not make __exit__ disagree with __enter__.
+        self._active = False
+
+    def __enter__(self):
+        if not _enabled:
+            return self
+        self._active = True
+        self._t0 = time.perf_counter()
+        if _use_jax_annotations:
+            try:
+                import jax.profiler
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(
+                    f"fed:{self._kind}:{self._peer}:{self._up}->{self._down}"
+                )
+                self._jax_ctx.__enter__()
+            except Exception:  # pragma: no cover - profiler unavailable
+                self._jax_ctx = None
+        return self
+
+    def set_nbytes(self, n: int) -> None:
+        self._nbytes = n
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._active:
+            return False
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(exc_type, exc, tb)
+        record = Span(
+            kind=self._kind,
+            peer=self._peer,
+            upstream_seq_id=self._up,
+            downstream_seq_id=self._down,
+            nbytes=self._nbytes,
+            start_s=self._t0,
+            duration_s=time.perf_counter() - self._t0,
+            ok=exc_type is None,
+        )
+        with _lock:
+            _spans.append(record)
+        return False

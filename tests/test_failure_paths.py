@@ -41,6 +41,13 @@ def run_exit_on_sending_failure(party, addresses):
         fed.get(out)
     except fed.FedRemoteError:
         pass
+    # Like the reference test, park the main thread: the drain thread's
+    # SIGINT interrupts the sleep and runs the unintended-shutdown path.
+    # (Calling fed.shutdown() here instead would RACE the drain thread for
+    # the shutdown-once flag and make the exit code nondeterministic.)
+    import time
+
+    time.sleep(60)
     fed.shutdown()
 
 
@@ -54,11 +61,11 @@ def test_exit_on_sending_failure_exits_nonzero():
         p.start()
     for p in procs.values():
         p.join(timeout=120)
-    # Alice's push of `bad` fails (producer raised); with
-    # exit_on_sending_failure it must exit 1. Bob receives the error
-    # envelope, re-raises as FedRemoteError, catches it, exits 0.
+    # Both parties exit 1 (ref test_cross_silo_error.py:268-308): alice's
+    # push of `bad` failed (producer raised); bob's broadcast of `out`
+    # failed the same way (its input was the error).
     assert procs["alice"].exitcode == 1, procs["alice"].exitcode
-    assert procs["bob"].exitcode == 0, procs["bob"].exitcode
+    assert procs["bob"].exitcode == 1, procs["bob"].exitcode
 
 
 def run_failure_handler(party, addresses, q):

@@ -1,0 +1,68 @@
+"""Pallas flash attention equivalence tests (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.models import transformer as tfm
+from rayfed_tpu.ops.flash_attention import flash_attention, make_flash_attn_fn
+
+
+def _qkv(key, b, s, h, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, h, d), dtype),
+        jax.random.normal(kk, (b, s, h, d), dtype),
+        jax.random.normal(kv, (b, s, h, d), dtype),
+    )
+
+
+@pytest.mark.parametrize("s,block", [(64, 16), (128, 128), (96, 32)])
+def test_matches_reference(s, block):
+    q, k, v = _qkv(jax.random.PRNGKey(0), 2, s, 2, 32)
+    expect = tfm.causal_attention(q, k, v)
+    got = flash_attention(q, k, v, block_q=block, block_k=block)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expect), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_q_offset_matches_suffix():
+    # Second half of the queries with q_offset == full-attention suffix.
+    s = 64
+    q, k, v = _qkv(jax.random.PRNGKey(1), 1, s, 2, 16)
+    full = tfm.causal_attention(q, k, v)
+    half = flash_attention(
+        q[:, s // 2:], k, v, block_q=16, block_k=16, q_offset=s // 2
+    )
+    np.testing.assert_allclose(
+        np.asarray(half), np.asarray(full[:, s // 2:]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_transformer_forward_with_flash_attn():
+    # f32 compute: in bf16 the flash kernel is MORE accurate than the
+    # reference path (full f32 accumulation vs bf16 prob-matmul), so
+    # logits drift apart through layers for reasons that are not bugs.
+    cfg = tfm.tiny_config(compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 32), 0, cfg.vocab)
+    ref_logits = tfm.forward(params, tokens, cfg)
+    flash_logits = tfm.forward(
+        params, tokens, cfg, attn_fn=make_flash_attn_fn(block_q=16, block_k=16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash_logits), np.asarray(ref_logits), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv(jax.random.PRNGKey(3), 1, 32, 2, 16, jnp.bfloat16)
+    expect = tfm.causal_attention(q, k, v)
+    got = flash_attention(q, k, v, block_q=16, block_k=16)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expect, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )

@@ -1,0 +1,122 @@
+"""Transport-level tests driving the proxies directly (mirror of ref
+``fed/tests/test_transport_proxy.py`` and
+``multi-jobs/test_ignore_other_job_msg.py``): concurrent send/recv pairs,
+job-name 417 on the wire, recv deadlines, tracing spans."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from rayfed_tpu import tracing
+from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
+from tests.utils import get_addresses
+
+FAST = {"retry_policy": {"max_attempts": 5, "initial_backoff_ms": 100}}
+
+
+def _pair(job_sender="job", job_receiver="job", sender_cfg=None,
+          receiver_cfg=None):
+    addr = get_addresses(["bob"])
+    rp = TcpReceiverProxy(
+        addr["bob"], "bob", job_receiver, None, receiver_cfg or dict(FAST)
+    )
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    sp = TcpSenderProxy(addr, "alice", job_sender, None,
+                        sender_cfg or dict(FAST))
+    sp.start()
+    return sp, rp
+
+
+def test_concurrent_send_recv_pairs():
+    sp, rp = _pair()
+    n = 20
+    recvs = [rp.get_data("alice", f"{i}#0", i) for i in range(0, n, 2)]
+    sends = [
+        sp.send("bob", {"i": np.full((64,), i, np.int32)}, f"{i}#0", i)
+        for i in range(n)
+    ]
+    assert all(f.result(timeout=30) for f in sends)
+    late_recvs = [rp.get_data("alice", f"{i}#0", i) for i in range(1, n, 2)]
+    for i, f in zip(range(0, n, 2), recvs):
+        assert f.result(timeout=30)["i"][0] == i
+    for i, f in zip(range(1, n, 2), late_recvs):
+        assert f.result(timeout=30)["i"][0] == i
+    assert sp.get_stats()["send_op_count"] == n
+    assert rp.get_stats()["receive_op_count"] == n
+    sp.stop()
+    rp.stop()
+
+
+def test_job_name_mismatch_417_on_wire():
+    sp, rp = _pair(job_sender="jobA", job_receiver="jobB")
+    fut = sp.send("bob", "data", "1#0", 2)
+    with pytest.raises(RuntimeError, match="417"):
+        fut.result(timeout=30)
+    # The alien payload must NOT be delivered to a waiter.
+    parked = rp.get_data("alice", "1#0", 2)
+    assert not parked.done()
+    sp.stop()
+    rp.stop()
+
+
+def test_recv_deadline_expires_waiter():
+    cfg = {**FAST, "recv_timeout_in_ms": 500}
+    sp, rp = _pair(receiver_cfg=cfg)
+    fut = rp.get_data("alice", "99#0", 100)
+    with pytest.raises(TimeoutError, match="recv_timeout_in_ms"):
+        fut.result(timeout=10)
+    # Data arriving after expiry parks for a later (never-coming) taker
+    # without crashing the server.
+    assert sp.send("bob", "late", "99#0", 100).result(timeout=10)
+    sp.stop()
+    rp.stop()
+
+
+def test_recv_deadline_not_triggered_when_data_flows():
+    cfg = {**FAST, "recv_timeout_in_ms": 2000}
+    sp, rp = _pair(receiver_cfg=cfg)
+    fut = rp.get_data("alice", "5#0", 6)
+    assert sp.send("bob", {"x": np.ones(8)}, "5#0", 6).result(timeout=10)
+    np.testing.assert_array_equal(fut.result(timeout=10)["x"], np.ones(8))
+    sp.stop()
+    rp.stop()
+
+
+def test_tracing_spans_record_transfers():
+    tracing.clear()
+    tracing.enable()
+    try:
+        sp, rp = _pair()
+        fut = rp.get_data("alice", "1#0", 2)
+        payload = {"g": np.ones((1024,), np.float32)}
+        assert sp.send("bob", payload, "1#0", 2).result(timeout=30)
+        np.testing.assert_array_equal(fut.result(timeout=30)["g"].ravel(),
+                                      np.ones(1024, np.float32))
+        sp.stop()
+        rp.stop()
+        sends = tracing.get_spans("send")
+        recvs = tracing.get_spans("recv")
+        decodes = tracing.get_spans("decode")
+        assert len(sends) == 1 and sends[0].nbytes == 4096
+        assert sends[0].peer == "bob" and sends[0].ok
+        assert len(recvs) == 1 and recvs[0].peer == "alice"
+        assert len(decodes) == 1
+        s = tracing.summary()
+        assert s["send"]["count"] == 1 and s["send"]["bytes"] == 4096
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_tracing_disabled_records_nothing():
+    tracing.clear()
+    sp, rp = _pair()
+    fut = rp.get_data("alice", "1#0", 2)
+    assert sp.send("bob", "x", "1#0", 2).result(timeout=30)
+    assert fut.result(timeout=30) == "x"
+    sp.stop()
+    rp.stop()
+    assert tracing.get_spans() == []
