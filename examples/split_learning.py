@@ -1,0 +1,98 @@
+"""Split learning: alice holds the feature extractor + raw data, bob holds
+the head + labels. Only activations and activation-gradients cross the
+boundary — both as ordinary owner-pushes.
+
+    python examples/split_learning.py alice 127.0.0.1:9121 127.0.0.1:9122
+    python examples/split_learning.py bob   127.0.0.1:9121 127.0.0.1:9122
+"""
+
+import sys
+
+import numpy as np
+
+import rayfed_tpu as fed
+
+STEPS = 10
+
+
+@fed.remote
+class Bottom:
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        self.x = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
+        self.w = jnp.asarray(
+            rng.normal(size=(32, 16)).astype(np.float32) * 0.1
+        )
+        self._fwd = jax.jit(lambda x, w: jax.nn.tanh(x @ w))
+
+        def bwd(x, w, h, gh):
+            gz = gh * (1 - h**2)  # tanh'
+            return w - 0.1 * (x.T @ gz) / x.shape[0]
+
+        self._bwd = jax.jit(bwd)
+
+    def forward(self):
+        self.h = self._fwd(self.x, self.w)
+        return self.h
+
+    def backward(self, grad_h):
+        self.w = self._bwd(self.x, self.w, self.h, grad_h)
+
+
+@fed.remote
+class Head:
+    def __init__(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        self.wh = jnp.asarray(rng.normal(size=(16, 1)).astype(np.float32) * 0.1)
+        self.y = jnp.asarray(rng.normal(size=(64, 1)).astype(np.float32))
+
+        def step(wh, h, y):
+            def loss_fn(wh, h):
+                return ((h @ wh - y) ** 2).mean()
+
+            loss, (gwh, gh) = jax.value_and_grad(
+                lambda wh, h: loss_fn(wh, h), argnums=(0, 1)
+            )(wh, h)
+            return wh - 0.1 * gwh, gh, loss
+
+        self._step = jax.jit(step)
+
+    def step(self, h):
+        self.wh, grad_h, loss = self._step(self.wh, h, self.y)
+        self.loss = float(loss)
+        return grad_h
+
+    def get_loss(self):
+        return self.loss
+
+
+def main():
+    party, addr_a, addr_b = sys.argv[1], sys.argv[2], sys.argv[3]
+    fed.init(
+        addresses={"alice": addr_a, "bob": addr_b},
+        party=party,
+        config={
+            "cross_silo_comm": {
+                "retry_policy": {"max_attempts": 30, "initial_backoff_ms": 500}
+            }
+        },
+    )
+    bottom = Bottom.party("alice").remote()
+    head = Head.party("bob").remote()
+    for step in range(STEPS):
+        h = bottom.forward.remote()
+        grad_h = head.step.remote(h)
+        bottom.backward.remote(grad_h)
+        loss = fed.get(head.get_loss.remote())
+        print(f"[{party}] step {step}: loss {loss:.5f}")
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

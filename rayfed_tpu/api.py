@@ -152,7 +152,13 @@ def init(
 
     # Optional TPU binding: establish the party's device mesh before any
     # task is jit-compiled on it (SURVEY.md §3.1 "In a TPU build `init`
-    # additionally establishes the party-slice mesh").
+    # additionally establishes the party-slice mesh"). A multi-host party
+    # first joins its jax.distributed process group.
+    jax_dist = config.get("jax_distributed")
+    if jax_dist is not None:
+        from rayfed_tpu.mesh import init_distributed
+
+        init_distributed(**jax_dist)
     party_mesh_dict = config.get("party_mesh")
     if party_mesh_dict is not None or transport == "tpu":
         from rayfed_tpu.mesh import init_party_mesh

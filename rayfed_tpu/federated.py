@@ -1,0 +1,158 @@
+"""High-level federated learning API over the execution engine.
+
+The reference leaves FedAvg entirely to user code (``README.md:59-104``);
+these helpers package the standard patterns while preserving the engine's
+semantics — every helper builds an ordinary fed DAG, so the owner-push
+perimeter, seq-id determinism, and error envelopes all apply unchanged.
+
+``fed_aggregate`` reduces per-party FedObjects with a **pairwise
+hierarchical tree** (BASELINE.json config #4): with n parties the reduction
+runs in ceil(log2 n) rounds of 2-way jitted reduces, halving the
+coordinator's fan-in (and its inbound bandwidth) versus the naive
+all-to-coordinator star.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def _agg_pair_sum(a, b):
+    from rayfed_tpu.ops.aggregate import tree_sum
+
+    return tree_sum(a, b)
+
+
+@fed.remote
+def _agg_pair_weighted(a, b):
+    # a, b: (tree, weight) pairs; returns (weighted-sum tree, total weight).
+    from rayfed_tpu.ops.aggregate import tree_sum
+
+    (ta, wa), (tb, wb) = a, b
+    return tree_sum(ta, tb), wa + wb
+
+
+@fed.remote
+def _scale(tree, denom):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: x / denom, tree)
+
+
+@fed.remote
+def _scale_weighted(pair):
+    import jax
+
+    tree, total = pair
+    return jax.tree_util.tree_map(lambda x: x / total, tree)
+
+
+@fed.remote
+def _premul(tree, w):
+    import jax
+
+    return (jax.tree_util.tree_map(lambda x: x * w, tree), w)
+
+
+def fed_aggregate(
+    objs: Dict[str, Any],
+    op: str = "mean",
+    weights: Optional[Dict[str, float]] = None,
+) -> Any:
+    """Reduce ``{party: FedObject-of-pytree}`` hierarchically.
+
+    The result lives at the first party (tree root); pass it to
+    ``fed.get`` to broadcast, or feed it onwards in the DAG. All parties
+    must call this with the same arguments (multi-controller contract).
+
+    op: "sum", "mean", or "wmean" (sample-count weighting via ``weights``).
+    """
+    assert objs, "need at least one party's object"
+    parties = list(objs.keys())
+    if op == "wmean":
+        assert weights is not None, "op='wmean' needs weights={party: w}"
+        missing = set(parties) - set(weights)
+        if missing:
+            raise ValueError(
+                f"op='wmean' weights missing entries for parties "
+                f"{sorted(missing)}"
+            )
+        level = [
+            _premul.party(p).remote(objs[p], float(weights[p]))
+            for p in parties
+        ]
+        reducer = _agg_pair_weighted
+    else:
+        assert op in ("sum", "mean"), op
+        level = [objs[p] for p in parties]
+        reducer = _agg_pair_sum
+    owners = list(parties)
+
+    # ceil(log2 n) rounds of pairwise reduces; each reduce executes at the
+    # left operand's owner, so traffic per round is one push per pair.
+    while len(level) > 1:
+        nxt, nxt_owners = [], []
+        for i in range(0, len(level) - 1, 2):
+            nxt.append(
+                reducer.party(owners[i]).remote(level[i], level[i + 1])
+            )
+            nxt_owners.append(owners[i])
+        if len(level) % 2:
+            nxt.append(level[-1])
+            nxt_owners.append(owners[-1])
+        level, owners = nxt, nxt_owners
+
+    root, root_owner = level[0], owners[0]
+    if op == "mean":
+        return _scale.party(root_owner).remote(root, float(len(parties)))
+    if op == "wmean":
+        return _scale_weighted.party(root_owner).remote(root)
+    return root
+
+
+class FedAvgTrainer:
+    """Multi-round FedAvg orchestration: per-party worker actors train
+    locally, aggregates flow through :func:`fed_aggregate`, and the global
+    model feeds the next round.
+
+    ``worker_cls`` is a ``@fed.remote`` actor class exposing
+    ``train(global_params_or_None) -> params`` (and optionally
+    ``num_samples() -> float`` for weighted averaging).
+    """
+
+    def __init__(
+        self,
+        worker_cls,
+        parties: Sequence[str],
+        worker_args: Optional[Dict[str, tuple]] = None,
+        op: str = "mean",
+        weights: Optional[Dict[str, float]] = None,
+    ):
+        self._parties = list(parties)
+        self._op = op
+        self._weights = weights
+        worker_args = worker_args or {}
+        self._workers = {
+            p: worker_cls.party(p).remote(*worker_args.get(p, ()))
+            for p in self._parties
+        }
+
+    @property
+    def workers(self):
+        return self._workers
+
+    def run(self, rounds: int, global_params=None):
+        """Run ``rounds`` federated rounds; returns the final aggregate as
+        a FedObject owned by the first party."""
+        for _ in range(rounds):
+            locals_ = {
+                p: self._workers[p].train.remote(global_params)
+                for p in self._parties
+            }
+            global_params = fed_aggregate(
+                locals_, op=self._op, weights=self._weights
+            )
+        return global_params

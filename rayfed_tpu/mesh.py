@@ -23,6 +23,40 @@ _party_mesh = None
 _party_mesh_config: Optional[PartyMeshConfig] = None
 
 
+def init_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    local_device_ids=None,
+) -> None:
+    """Join a multi-host JAX process group (real multi-host TPU slices).
+
+    A *party* spanning several hosts calls this on each host before
+    ``fed.init`` (or passes ``config['jax_distributed']``), after which
+    ``jax.devices()`` spans the party's whole slice and the party mesh /
+    collectives ride ICI+DCN. Cross-party traffic still flows through the
+    fed transport — the process group is per-party, preserving the data
+    perimeter.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        # Repeat fed.init in the same process (shutdown()+init() restart
+        # pattern): the process group outlives the fed runtime.
+        logger.info("jax.distributed already initialized; reusing group.")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    logger.info(
+        "Joined jax.distributed group %s as process %d/%d",
+        coordinator_address, process_id, num_processes,
+    )
+
+
 def build_mesh(
     device_ids: Optional[List[int]] = None,
     mesh_shape: Optional[List[int]] = None,

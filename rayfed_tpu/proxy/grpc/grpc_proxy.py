@@ -148,6 +148,10 @@ class GrpcSenderProxy(SenderProxy):
 
     def _send_sync(self, dest_party, data, upstream_seq_id, downstream_seq_id,
                    is_error: bool) -> bool:
+        import time
+
+        from rayfed_tpu import tracing
+
         if isinstance(data, Future):
             try:
                 data = data.result()
@@ -156,6 +160,7 @@ class GrpcSenderProxy(SenderProxy):
         # Parity hot path: cloudpickle the whole payload (ref
         # grpc_proxy.py:202) — this is exactly the cost the native
         # transports avoid.
+        t0 = time.perf_counter()
         blob = cloudpickle.dumps(data)
         request = _pack_request(
             self._job_name, self._party, upstream_seq_id, downstream_seq_id,
@@ -168,6 +173,10 @@ class GrpcSenderProxy(SenderProxy):
         )
         resp_bytes = stub(request, timeout=self._config.timeout_in_ms / 1000)
         resp = msgpack.unpackb(resp_bytes, raw=False)
+        tracing.record(
+            "send", dest_party, upstream_seq_id, downstream_seq_id,
+            len(blob), t0,
+        )
         with self._stats_lock:
             self._stats["send_op_count"] += 1
         if resp["code"] == CODE_OK:

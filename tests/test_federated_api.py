@@ -1,0 +1,157 @@
+"""High-level federated API tests: hierarchical aggregation over 4 parties
+(BASELINE.json config #4), weighted FedAvg, the trainer wrapper, and the
+split-learning pattern (SURVEY.md §2 parallelism table)."""
+
+import numpy as np
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import FedAvgTrainer, fed_aggregate
+from tests.utils import FAST_COMM_CONFIG, run_parties
+
+PARTIES4 = ["alice", "bob", "carol", "dave"]
+CONFIG = {"cross_silo_comm": dict(FAST_COMM_CONFIG)}
+
+
+@fed.remote
+def contrib(v):
+    return {"w": np.full((8,), v, np.float32)}
+
+
+def run_hierarchical_mean(party, addresses):
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    vals = {p: float(i + 1) for i, p in enumerate(PARTIES4)}
+    objs = {p: contrib.party(p).remote(vals[p]) for p in PARTIES4}
+    agg = fed_aggregate(objs, op="mean")
+    out = fed.get(agg)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(8, 2.5), rtol=1e-6)
+    fed.shutdown()
+
+
+def test_four_party_hierarchical_mean():
+    run_parties(run_hierarchical_mean, PARTIES4, timeout=180)
+
+
+def run_three_party_sum(party, addresses):
+    # Odd party count exercises the carry-through branch of the tree.
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    parties = ["alice", "bob", "carol"]
+    objs = {p: contrib.party(p).remote(float(i)) for i, p in enumerate(parties)}
+    out = fed.get(fed_aggregate(objs, op="sum"))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(8, 3.0), rtol=1e-6)
+    fed.shutdown()
+
+
+def test_three_party_sum():
+    run_parties(run_three_party_sum, ["alice", "bob", "carol"], timeout=180)
+
+
+def run_weighted_mean(party, addresses):
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    objs = {
+        "alice": contrib.party("alice").remote(1.0),
+        "bob": contrib.party("bob").remote(5.0),
+    }
+    out = fed.get(
+        fed_aggregate(objs, op="wmean", weights={"alice": 3.0, "bob": 1.0})
+    )
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full(8, 2.0), rtol=1e-6)
+    fed.shutdown()
+
+
+def test_weighted_mean():
+    run_parties(run_weighted_mean, ["alice", "bob"])
+
+
+@fed.remote
+class LinWorker:
+    """w <- w - lr * grad of ||x w - y||^2 on a party-local shard."""
+
+    def __init__(self, seed):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(32, 4)).astype(np.float32)
+        true_w = np.arange(1.0, 5.0, dtype=np.float32)
+        self.y = self.x @ true_w
+        self.w = np.zeros(4, np.float32)
+
+    def train(self, global_w):
+        if global_w is not None:
+            self.w = np.asarray(global_w["w"])
+        for _ in range(5):
+            grad = 2 * self.x.T @ (self.x @ self.w - self.y) / len(self.y)
+            self.w = self.w - 0.05 * grad
+        return {"w": self.w}
+
+
+def run_trainer(party, addresses):
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    trainer = FedAvgTrainer(
+        LinWorker, ["alice", "bob"],
+        worker_args={"alice": (1,), "bob": (2,)},
+    )
+    final = fed.get(trainer.run(rounds=15))
+    np.testing.assert_allclose(
+        np.asarray(final["w"]), np.arange(1.0, 5.0, dtype=np.float32),
+        atol=0.25,
+    )
+    fed.shutdown()
+
+
+def test_fedavg_trainer_converges():
+    run_parties(run_trainer, ["alice", "bob"], timeout=180)
+
+
+def run_split_learning(party, addresses):
+    """Split learning: alice owns the bottom of the model + data, bob owns
+    the head + labels; activations go forward, gradients come back — both
+    as ordinary owner-pushes (SURVEY.md: engine-level it's just send/recv)."""
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+
+    @fed.remote
+    class Bottom:
+        def __init__(self):
+            rng = np.random.default_rng(0)
+            self.x = rng.normal(size=(16, 8)).astype(np.float32)
+            self.w = rng.normal(size=(8, 4)).astype(np.float32) * 0.1
+
+        def forward(self):
+            self.h = self.x @ self.w
+            return self.h
+
+        def backward(self, grad_h):
+            grad_w = self.x.T @ grad_h / len(self.x)
+            self.w = self.w - 0.1 * grad_w
+            return float(np.abs(grad_w).sum())
+
+    @fed.remote
+    class Head:
+        def __init__(self):
+            rng = np.random.default_rng(1)
+            self.wh = rng.normal(size=(4, 1)).astype(np.float32) * 0.1
+            self.y = rng.normal(size=(16, 1)).astype(np.float32)
+
+        def step(self, h):
+            pred = h @ self.wh
+            err = pred - self.y
+            self.loss = float((err**2).mean())
+            grad_h = err @ self.wh.T / len(h)
+            grad_wh = h.T @ err / len(h)
+            self.wh = self.wh - 0.1 * grad_wh
+            return grad_h
+
+        def get_loss(self):
+            return self.loss
+
+    bottom = Bottom.party("alice").remote()
+    head = Head.party("bob").remote()
+    losses = []
+    for _ in range(6):
+        h = bottom.forward.remote()          # alice -> bob activations
+        grad_h = head.step.remote(h)         # bob -> alice gradients
+        bottom.backward.remote(grad_h)
+        losses.append(fed.get(head.get_loss.remote()))
+    assert losses[-1] < losses[0], losses
+    fed.shutdown()
+
+
+def test_split_learning_pattern():
+    run_parties(run_split_learning, ["alice", "bob"], timeout=180)

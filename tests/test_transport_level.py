@@ -68,8 +68,8 @@ def test_recv_deadline_expires_waiter():
     fut = rp.get_data("alice", "99#0", 100)
     with pytest.raises(TimeoutError, match="recv_timeout_in_ms"):
         fut.result(timeout=10)
-    # Data arriving after expiry parks for a later (never-coming) taker
-    # without crashing the server.
+    # Data arriving after expiry hits the tombstoned key and is
+    # acked-and-dropped like a duplicate (no leak, no crash).
     assert sp.send("bob", "late", "99#0", 100).result(timeout=10)
     sp.stop()
     rp.stop()
