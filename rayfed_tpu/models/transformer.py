@@ -33,10 +33,13 @@ class TransformerConfig:
     d_model: int = 512
     n_heads: int = 8
     n_layers: int = 4
-    d_ff: int = 1408  # SwiGLU hidden width
+    d_ff: int = 1408  # SwiGLU (or per-expert MoE) hidden width
     rope_theta: float = 10000.0
     compute_dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # 0 = dense SwiGLU FFN; >0 = top-1 MoE FFN with this many experts
+    # (expert-parallel over an "expert" mesh axis; see models/moe.py).
+    n_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -67,17 +70,27 @@ def init_params(rng, cfg: TransformerConfig) -> Params:
 
     def layer(key):
         ks = jax.random.split(key, 7)
-        return {
+        out = {
             "ln1": jnp.ones((d,), cfg.param_dtype),
             "wq": dense(ks[0], (d, h, dh), d),
             "wk": dense(ks[1], (d, h, dh), d),
             "wv": dense(ks[2], (d, h, dh), d),
             "wo": dense(ks[3], (h, dh, d), h * dh),
             "ln2": jnp.ones((d,), cfg.param_dtype),
-            "w_gate": dense(ks[4], (d, f), d),
-            "w_up": dense(ks[5], (d, f), d),
-            "w_down": dense(ks[6], (f, d), f),
         }
+        if cfg.n_experts > 0:
+            from rayfed_tpu.models.moe import init_moe_ffn
+
+            out["moe"] = init_moe_ffn(
+                ks[4], d, f, cfg.n_experts, dtype=cfg.param_dtype
+            )
+        else:
+            out.update(
+                w_gate=dense(ks[4], (d, f), d),
+                w_up=dense(ks[5], (d, f), d),
+                w_down=dense(ks[6], (f, d), f),
+            )
+        return out
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
     stacked = jax.tree_util.tree_map(
@@ -161,9 +174,17 @@ def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
     o = attn_fn(q, k, v)
     x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
     hmlp = rms_norm(x, layer["ln2"])
-    gate = jax.nn.silu(hmlp @ layer["w_gate"].astype(cdt))
-    up = hmlp @ layer["w_up"].astype(cdt)
-    x = x + (gate * up) @ layer["w_down"].astype(cdt)
+    if cfg.n_experts > 0:
+        from rayfed_tpu.models.moe import moe_ffn_apply
+
+        moe = jax.tree_util.tree_map(
+            lambda p: p.astype(cdt), layer["moe"]
+        )
+        x = x + moe_ffn_apply(moe, hmlp)
+    else:
+        gate = jax.nn.silu(hmlp @ layer["w_gate"].astype(cdt))
+        up = hmlp @ layer["w_up"].astype(cdt)
+        x = x + (gate * up) @ layer["w_down"].astype(cdt)
     return x
 
 

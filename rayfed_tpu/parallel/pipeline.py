@@ -1,0 +1,128 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``stage``
+mesh axis.
+
+The transformer's stacked layer parameters reshape to
+``(n_stages, layers_per_stage, ...)`` with the leading dim sharded over
+``stage``; inside ``shard_map`` each device applies only its own stage's
+layers, activations hop stage→stage via ``lax.ppermute`` (one ICI neighbor
+hop per pipeline tick), and the whole schedule is a ``lax.scan`` of
+``n_microbatches + n_stages - 1`` ticks — so XLA compiles ONE tick body and
+autodiff derives the reverse schedule through the scan + ppermute
+transpose. The last stage accumulates the LM loss; a final ``psum`` over
+the stage axis publishes it everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rayfed_tpu.models import transformer as tfm
+
+
+def stack_to_stages(params, n_stages: int):
+    """Reshape stacked layer leaves (L, ...) -> (S, L/S, ...)."""
+    def reshape(x):
+        l = x.shape[0]
+        assert l % n_stages == 0, (l, n_stages)
+        return x.reshape((n_stages, l // n_stages) + x.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, params["layers"])
+
+
+def make_pp_loss_fn(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    n_microbatches: int = 4,
+):
+    """Build ``loss(params, inputs, targets)`` running the pipeline over
+    ``mesh``'s ``stage_axis``. ``params`` is a standard transformer param
+    tree; batch must be divisible by ``n_microbatches``; ``cfg.n_layers``
+    by the stage count."""
+    n_stages = mesh.shape[stage_axis]
+    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
+    m_micro = n_microbatches
+
+    def body(stages_local, embed, ln_f, lm_head, inputs, targets):
+        # stages_local leaves: (1, L/S, ...) — this device's stage slice.
+        layers_local = jax.tree_util.tree_map(lambda x: x[0], stages_local)
+        s = lax.axis_index(stage_axis)
+        batch, seq = inputs.shape
+        assert batch % m_micro == 0, (batch, m_micro)
+        mb = batch // m_micro
+        micro_in = inputs.reshape(m_micro, mb, seq)
+        micro_tgt = targets.reshape(m_micro, mb, seq)
+        positions = jnp.broadcast_to(jnp.arange(seq), (mb, seq))
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def apply_stage(h):
+            def one_layer(h, layer):
+                return tfm.layer_fn(h, layer, positions, cfg), None
+
+            h, _ = lax.scan(one_layer, h, layers_local)
+            return h
+
+        def micro_loss(h, tgt):
+            x = tfm.rms_norm(h, ln_f)
+            logits = (x @ lm_head.astype(cfg.compute_dtype)).astype(
+                jnp.float32
+            )
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+            return (logz - gold).mean()
+
+        def tick(carry, t):
+            h_prev, acc = carry
+            m = t - s
+            valid = jnp.logical_and(m >= 0, m < m_micro)
+            m_c = jnp.clip(m, 0, m_micro - 1)
+            # Stage 0 ingests a fresh (embedded) microbatch; later stages
+            # consume the activation ppermuted in on the previous tick.
+            # cond (not where) so non-first stages skip the gather and
+            # non-last stages skip the full-vocab projection entirely.
+            h_in = lax.cond(
+                s == 0,
+                lambda: embed[micro_in[m_c]].astype(cfg.compute_dtype),
+                lambda: h_prev,
+            )
+            h_out = apply_stage(h_in)
+            is_last = s == n_stages - 1
+            acc = acc + lax.cond(
+                jnp.logical_and(valid, is_last),
+                lambda: micro_loss(h_out, micro_tgt[m_c]),
+                lambda: jnp.float32(0.0),
+            )
+            h_next = lax.ppermute(h_out, stage_axis, fwd_perm)
+            return (h_next, acc), None
+
+        h0 = jnp.zeros((mb, seq, cfg.d_model), cfg.compute_dtype)
+        (_, acc), _ = lax.scan(
+            tick, (h0, jnp.float32(0.0)), jnp.arange(m_micro + n_stages - 1)
+        )
+        # Only the last stage accumulated loss; publish it to all stages.
+        return lax.psum(acc, stage_axis) / m_micro
+
+    stage_spec_leaves = P(stage_axis)
+    rep = P()
+
+    smapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_spec_leaves, rep, rep, rep, rep, rep),
+        out_specs=rep,
+        check_vma=False,
+    )
+
+    def loss_fn(params, inputs, targets):
+        stages = stack_to_stages(params, n_stages)
+        return smapped(
+            stages, params["embed"], params["ln_f"], params["lm_head"],
+            inputs, targets,
+        )
+
+    return loss_fn

@@ -26,6 +26,9 @@ TRANSFORMER_RULES: List[Tuple[str, P]] = [
     (r"layers/w_(gate|up)$", P(None, "model")),
     (r"layers/w_down$", P("model", None)),
     (r"layers/ln[12]$", P()),
+    # MoE FFN: experts shard over the "expert" axis; router replicated.
+    (r"layers/moe/router$", P()),
+    (r"layers/moe/w_(up|down)$", P("expert", None, None)),
     (r"embed$", P(None, None)),
     (r"lm_head$", P(None, "model")),
     (r"ln_f$", P()),
@@ -71,10 +74,25 @@ def make_param_specs(params, rules=TRANSFORMER_RULES):
     )
 
 
+def prune_spec_to_mesh(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh does not have (e.g. the 'model' rules on a
+    party x expert mesh): absent axes mean 'replicated here'."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            return kept if kept else None
+        return entry if entry in mesh.axis_names else None
+
+    return P(*(keep(e) for e in spec))
+
+
 def make_param_shardings(mesh: Mesh, params, rules=TRANSFORMER_RULES):
     specs = make_param_specs(params, rules)
     return jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec), specs,
+        lambda spec: NamedSharding(mesh, prune_spec_to_mesh(spec, mesh)),
+        specs,
         is_leaf=lambda x: isinstance(x, P),
     )
 

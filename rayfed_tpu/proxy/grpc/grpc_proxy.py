@@ -171,15 +171,21 @@ class GrpcSenderProxy(SenderProxy):
             request_serializer=_identity,
             response_deserializer=_identity,
         )
-        resp_bytes = stub(request, timeout=self._config.timeout_in_ms / 1000)
-        resp = msgpack.unpackb(resp_bytes, raw=False)
-        tracing.record(
-            "send", dest_party, upstream_seq_id, downstream_seq_id,
-            len(blob), t0,
-        )
+        ok = False
+        try:
+            resp_bytes = stub(
+                request, timeout=self._config.timeout_in_ms / 1000
+            )
+            resp = msgpack.unpackb(resp_bytes, raw=False)
+            ok = resp["code"] == CODE_OK
+        finally:
+            tracing.record(
+                "send", dest_party, upstream_seq_id, downstream_seq_id,
+                len(blob), t0, ok=ok,
+            )
         with self._stats_lock:
             self._stats["send_op_count"] += 1
-        if resp["code"] == CODE_OK:
+        if ok:
             return True
         logger.warning(
             "peer rejected send: code=%s message=%s", resp["code"], resp["msg"]
