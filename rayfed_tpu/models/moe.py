@@ -43,13 +43,20 @@ def _gates(params: Params, x, top1: bool):
     return probs.astype(x.dtype)
 
 
+def _expert_ffn_combine(w_up, w_down, x, gates):
+    """Shared FFN math: run `E_local` experts on all tokens, gate-combine.
+    Both the dense and the expert-parallel paths call this — they must
+    never diverge (test_ep_moe_matches_dense pins the equivalence)."""
+    up = jnp.einsum("...d,edf->...ef", x, w_up)
+    act = jax.nn.gelu(up)
+    out = jnp.einsum("...ef,efd->...ed", act, w_down)
+    return jnp.einsum("...ed,...e->...d", out, gates)
+
+
 def moe_ffn_apply(params: Params, x, top1: bool = True):
     """Reference (single-device) forward: x (..., d) -> (..., d)."""
     gates = _gates(params, x, top1)  # (..., E)
-    up = jnp.einsum("...d,edf->...ef", x, params["w_up"])
-    act = jax.nn.gelu(up)
-    out = jnp.einsum("...ef,efd->...ed", act, params["w_down"])
-    return jnp.einsum("...ed,...e->...d", out, gates)
+    return _expert_ffn_combine(params["w_up"], params["w_down"], x, gates)
 
 
 def make_ep_moe_apply(mesh: Mesh, expert_axis: str = "expert"):
@@ -67,10 +74,9 @@ def make_ep_moe_apply(mesh: Mesh, expert_axis: str = "expert"):
         local_gates = lax.dynamic_slice_in_dim(
             gates, lo, n_exp_local, axis=-1
         )
-        up = jnp.einsum("...d,edf->...ef", x, params["w_up"])
-        act = jax.nn.gelu(up)
-        out = jnp.einsum("...ef,efd->...ed", act, params["w_down"])
-        local = jnp.einsum("...ed,...e->...d", out, local_gates)
+        local = _expert_ffn_combine(
+            params["w_up"], params["w_down"], x, local_gates
+        )
         return lax.psum(local, expert_axis)
 
     return shard_map(

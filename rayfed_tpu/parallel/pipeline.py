@@ -14,8 +14,6 @@ the stage axis publishes it everywhere.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
