@@ -120,3 +120,27 @@ def test_tracing_disabled_records_nothing():
     sp.stop()
     rp.stop()
     assert tracing.get_spans() == []
+
+
+def test_retry_policy_plumbed_to_proxy_config():
+    # Mirror of ref test_retry_policy.py / test_grpc_options_on_proxies.py:
+    # user-supplied retry policy must reach the transport's effective config.
+    cfg = {
+        "retry_policy": {
+            "max_attempts": 7,
+            "initialBackoff": "2s",   # reference-style camelCase accepted
+            "maxBackoff": "9s",
+            "backoffMultiplier": 3,
+        },
+        "timeout_in_ms": 12345,
+    }
+    sp, rp = _pair(sender_cfg=cfg)
+    eff = sp.get_proxy_config()
+    assert eff.timeout_in_ms == 12345
+    policy = eff.get_retry_policy()
+    assert policy.max_attempts == 7
+    assert policy.initial_backoff_ms == 2000
+    assert policy.max_backoff_ms == 9000
+    assert policy.backoff_multiplier == 3
+    sp.stop()
+    rp.stop()
