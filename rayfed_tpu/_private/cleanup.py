@@ -105,22 +105,32 @@ class CleanupManager:
                 dest_party, upstream_seq_id, downstream_seq_id, e,
             )
             self._last_sending_error = e
-            if isinstance(e, FedLocalError) or self._fast_fail:
-                # Producer task raised (or we are tearing down and cannot
-                # wait): substitute an error envelope under the same seq ids
-                # the peer's recv is parked on so it unblocks.
-                from rayfed_tpu.proxy.barriers import send
+            # Substitute an error envelope under the same seq ids the
+            # peer's recv is parked on, for EVERY failure mode (the
+            # reference does the same for any RayError, cleanup.py:160-172):
+            # producer raised (FedLocalError), payload rejected (strict
+            # mode / size caps), or transport down — in the last case the
+            # envelope send fails too and is just logged by the error
+            # queue, but in the first two the transport is healthy and the
+            # envelope is what keeps the peer from hanging.
+            from rayfed_tpu.proxy.barriers import send
 
-                error_trace = None
-                if self._expose_error_trace and isinstance(e, FedLocalError):
-                    error_trace = e.cause
-                send(
-                    dest_party,
-                    FedRemoteError(self._current_party, error_trace),
-                    upstream_seq_id,
-                    downstream_seq_id,
-                    is_error=True,
+            error_trace = None
+            if self._expose_error_trace:
+                # Producer exceptions cross as objects (reference parity;
+                # whitelist them on the receiver). Transport/validation
+                # exceptions cross as strings — their classes (ssl.SSLError,
+                # wire errors) would just fail the peer's whitelist.
+                error_trace = (
+                    e.cause if isinstance(e, FedLocalError) else repr(e)
                 )
+            send(
+                dest_party,
+                FedRemoteError(self._current_party, error_trace),
+                upstream_seq_id,
+                downstream_seq_id,
+                is_error=True,
+            )
             res = False
 
         if not res and self._exit_on_sending_failure and not self._fast_fail:

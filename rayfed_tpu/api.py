@@ -102,6 +102,20 @@ def init(
     cross_silo_comm_dict = config.get("cross_silo_comm", {})
     cross_silo_comm_config = CrossSiloMessageConfig.from_dict(cross_silo_comm_dict)
 
+    # Validate transport-dependent config BEFORE any state is built, so a
+    # rejected init leaves nothing behind.
+    transport = transport or config.get("transport", "tcp")
+    if (
+        transport == "grpc"
+        and cross_silo_comm_config.allow_pickle_payloads is False
+    ):
+        raise ValueError(
+            "allow_pickle_payloads=False is incompatible with "
+            "transport='grpc': the gRPC parity lane pickles every payload "
+            "by design. Use the native 'tcp'/'tpu' transports for strict "
+            "arrays-only mode."
+        )
+
     init_global_context(
         job_name=job_name,
         current_party=party,
@@ -147,8 +161,6 @@ def init(
         exit_on_sending_failure=cross_silo_comm_config.exit_on_sending_failure,
         expose_error_trace=cross_silo_comm_config.expose_error_trace,
     )
-
-    transport = transport or config.get("transport", "tcp")
 
     # Optional TPU binding: establish the party's device mesh before any
     # task is jit-compiled on it (SURVEY.md §3.1 "In a TPU build `init`

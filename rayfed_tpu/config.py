@@ -102,6 +102,11 @@ class CrossSiloMessageConfig:
             (the reference caps gRPC at 500MB, grpc_options.py:28-29).
         serializing_allowed_list: {module: [class, ...]} whitelist for
             unpickling received non-array payloads.
+        allow_pickle_payloads: False = strict arrays-only mode — the
+            receiver rejects every pickle-kind data frame (error envelopes
+            excepted), removing the unpickling attack surface entirely for
+            deployments where peers are not fully trusted. Senders fail
+            fast on payloads that would need pickling.
         exit_on_sending_failure: SIGINT self when a push ultimately fails.
         expose_error_trace: include the real exception in the
             FedRemoteError envelope sent to peers.
@@ -113,6 +118,7 @@ class CrossSiloMessageConfig:
     recv_timeout_in_ms: Optional[int] = None
     messages_max_size_in_bytes: Optional[int] = None
     serializing_allowed_list: Optional[Dict[str, List[str]]] = None
+    allow_pickle_payloads: bool = True
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False

@@ -207,6 +207,7 @@ class GrpcReceiverProxy(ReceiverProxy):
             job_name, decode,
             max_payload_bytes=self._config.messages_max_size_in_bytes,
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
+            allow_pickle=self._config.allow_pickle_payloads,
         )
         self._server: Optional[grpc.Server] = None
         self._ready_result = None

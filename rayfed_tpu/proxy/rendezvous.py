@@ -23,6 +23,7 @@ from rayfed_tpu._private.constants import (
     CODE_INTERNAL_ERROR,
     CODE_JOB_MISMATCH,
     CODE_OK,
+    CODE_PICKLE_FORBIDDEN,
 )
 
 logger = logging.getLogger(__name__)
@@ -31,10 +32,18 @@ logger = logging.getLogger(__name__)
 DecodeFn = Callable[[Dict, memoryview], object]
 
 
-def default_decode(allowed_list):
+def default_decode(allowed_list, allow_pickle: bool = True):
     def decode(header: Dict, payload) -> object:
+        effective = allowed_list
+        if not allow_pickle and header.get("pkind") == "pickle":
+            # Strict mode: the only pickle frames that reach decode are
+            # error envelopes (offer() 415s the rest) — and an attacker
+            # could stamp is_error on anything, so they decode under the
+            # empty whitelist (FedRemoteError + builtin exception types
+            # only), never the unrestricted loader.
+            effective = {}
         return serialization.decode_payload(
-            header["pkind"], header.get("pmeta", b""), payload, allowed_list
+            header["pkind"], header.get("pmeta", b""), payload, effective
         )
 
     return decode
@@ -48,6 +57,7 @@ class RendezvousStore:
         max_payload_bytes: Optional[int] = None,
         decode_workers: int = 2,
         recv_timeout_s: Optional[float] = None,
+        allow_pickle: bool = True,
     ) -> None:
         self._job_name = job_name
         self._decode_fn = decode_fn
@@ -57,6 +67,7 @@ class RendezvousStore:
         if recv_timeout_s is not None and recv_timeout_s <= 0:
             recv_timeout_s = None
         self._recv_timeout_s = recv_timeout_s
+        self._allow_pickle = allow_pickle
         self._lock = threading.Lock()
         self._arrived: Dict[Tuple[str, str], Tuple[Dict, memoryview]] = {}
         self._waiters: Dict[Tuple[str, str], Future] = {}
@@ -129,6 +140,18 @@ class RendezvousStore:
             return (
                 CODE_INTERNAL_ERROR,
                 f"payload {nbytes} bytes exceeds limit {self._max_payload_bytes}",
+            )
+        if (
+            not self._allow_pickle
+            and header.get("pkind") == "pickle"
+            and not header.get("is_error")
+        ):
+            # Strict arrays-only mode: the unpickler never runs on data
+            # frames (error envelopes stay allowed — they carry our own
+            # whitelisted exception types).
+            return (
+                CODE_PICKLE_FORBIDDEN,
+                "pickle payloads are disabled (allow_pickle_payloads=False)",
             )
         key = (header["up"], header["down"])
         with self._lock:

@@ -209,8 +209,13 @@ class _DestWorker(threading.Thread):
             value = data
 
         kind, meta, buffers = serialization.encode_payload(value)
-        payload_len = sum(serialization.buffer_nbytes(b) for b in buffers)
         cfg = self._proxy._config
+        if kind == "pickle" and not cfg.allow_pickle_payloads and not is_error:
+            raise ValueError(
+                "payload requires pickling but allow_pickle_payloads=False "
+                "(strict arrays-only mode): send pytrees of arrays/scalars"
+            )
+        payload_len = sum(serialization.buffer_nbytes(b) for b in buffers)
         if (
             cfg.messages_max_size_in_bytes is not None
             and payload_len > cfg.messages_max_size_in_bytes
@@ -337,6 +342,7 @@ class TcpReceiverProxy(ReceiverProxy):
             self._make_decode_fn(),
             max_payload_bytes=self._config.messages_max_size_in_bytes,
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
+            allow_pickle=self._config.allow_pickle_payloads,
         )
         self._listener: Optional[socket.socket] = None
         self._ready_result = None
@@ -346,7 +352,10 @@ class TcpReceiverProxy(ReceiverProxy):
 
     def _make_decode_fn(self):
         """Hook: the TPU receiver overrides this to add device placement."""
-        return rendezvous.default_decode(self._config.serializing_allowed_list)
+        return rendezvous.default_decode(
+            self._config.serializing_allowed_list,
+            allow_pickle=self._config.allow_pickle_payloads,
+        )
 
     # -- lifecycle ------------------------------------------------------------
 

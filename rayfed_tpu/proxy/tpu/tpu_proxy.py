@@ -30,8 +30,8 @@ class TpuSenderProxy(TcpSenderProxy):
     worker (``np.asarray`` on a jax.Array) off the event loop."""
 
 
-def _device_placer(allowed_list):
-    base = rendezvous.default_decode(allowed_list)
+def _device_placer(allowed_list, allow_pickle: bool = True):
+    base = rendezvous.default_decode(allowed_list, allow_pickle=allow_pickle)
 
     def decode(header, payload):
         value = base(header, payload)
@@ -70,4 +70,7 @@ def _place_tree(value, mesh):
 
 class TpuReceiverProxy(TcpReceiverProxy):
     def _make_decode_fn(self):
-        return _device_placer(self._config.serializing_allowed_list)
+        return _device_placer(
+            self._config.serializing_allowed_list,
+            allow_pickle=self._config.allow_pickle_payloads,
+        )

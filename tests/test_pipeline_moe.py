@@ -147,3 +147,24 @@ def test_moe_transformer_trains_with_ep_rules():
         if i == 0:
             l0 = float(loss)
     assert np.isfinite(float(loss)) and float(loss) < l0
+
+
+def test_pp_composes_with_tp_and_dp_axes():
+    # shard_map is manual over 'stage' only; GSPMD auto-handles the other
+    # mesh axes inside the pipeline body, so pp composes with tp/dp.
+    from rayfed_tpu.parallel import sharding as shd
+
+    cfg = _cfg()  # n_layers=4, f32
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    serial = float(tfm.lm_loss_pair(params, inputs, targets, cfg))
+
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(2, 2, 2), ("stage", "model", "data")
+    )
+    # Model-axis-sharded params (the TP layout) must flow through unchanged.
+    params = shd.shard_params(mesh, params)
+    pp_loss = make_pp_loss_fn(cfg, mesh, n_microbatches=2)
+    got = float(jax.jit(pp_loss)(params, inputs, targets))
+    np.testing.assert_allclose(got, serial, rtol=1e-5)

@@ -144,3 +144,76 @@ def test_retry_policy_plumbed_to_proxy_config():
     assert policy.backoff_multiplier == 3
     sp.stop()
     rp.stop()
+
+
+class _Custom:
+    pass
+
+
+def test_strict_mode_sender_refuses_pickle_payloads():
+    cfg = {**FAST, "allow_pickle_payloads": False}
+    sp, rp = _pair(sender_cfg=cfg, receiver_cfg=cfg)
+    # Array pytrees still flow.
+    fut = rp.get_data("alice", "1#0", 2)
+    assert sp.send("bob", {"w": np.ones(4)}, "1#0", 2).result(timeout=30)
+    assert fut.result(timeout=30)["w"].sum() == 4
+    # A payload needing pickle fails fast at the sender.
+    bad = sp.send("bob", _Custom(), "3#0", 4)
+    with pytest.raises(ValueError, match="arrays-only"):
+        bad.result(timeout=30)
+    sp.stop()
+    rp.stop()
+
+
+def test_strict_mode_receiver_rejects_pickle_frames():
+    # Lenient sender vs strict receiver: the frame is refused on the wire
+    # with code 415 and the unpickler never runs.
+    sp, rp = _pair(receiver_cfg={**FAST, "allow_pickle_payloads": False})
+    fut = sp.send("bob", _Custom(), "1#0", 2)
+    with pytest.raises(RuntimeError, match="415"):
+        fut.result(timeout=30)
+    parked = rp.get_data("alice", "1#0", 2)
+    assert not parked.done()
+    sp.stop()
+    rp.stop()
+
+
+def test_strict_mode_error_envelopes_decode_under_empty_whitelist():
+    # An attacker stamping is_error=True on a pickle frame must NOT reach
+    # the unrestricted unpickler: strict receivers decode error frames
+    # under the empty whitelist (FedRemoteError + builtin exceptions only).
+    import pickle as _pickle
+
+    from rayfed_tpu.exceptions import FedRemoteError
+
+    sp, rp = _pair(receiver_cfg={**FAST, "allow_pickle_payloads": False})
+    # Legit envelope passes.
+    fut = rp.get_data("alice", "1#0", 2)
+    sp.send("bob", FedRemoteError("alice", None), "1#0", 2,
+            is_error=True).result(timeout=30)
+    got = fut.result(timeout=30)
+    assert isinstance(got, FedRemoteError)
+    # Malicious "error" carrying a non-whitelisted class is refused by the
+    # unpickler (surfaces as UnpicklingError on the waiter, no execution).
+    fut2 = rp.get_data("alice", "3#0", 4)
+    sp.send("bob", _Custom(), "3#0", 4, is_error=True).result(timeout=30)
+    with pytest.raises(_pickle.UnpicklingError):
+        fut2.result(timeout=30)
+    sp.stop()
+    rp.stop()
+
+
+def test_strict_mode_rejects_grpc_transport():
+    import rayfed_tpu as fed
+
+    with pytest.raises(ValueError, match="incompatible"):
+        fed.init(
+            addresses={"alice": "127.0.0.1:45999"},
+            party="alice",
+            transport="grpc",
+            config={"cross_silo_comm": {"allow_pickle_payloads": False}},
+        )
+    # The rejected init must not leave a half-built context behind.
+    from rayfed_tpu._private.global_context import get_global_context
+
+    assert get_global_context() is None
