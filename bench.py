@@ -28,7 +28,7 @@ import time
 
 PAYLOAD_MB = 100
 ROUNDS = 5
-REPS = 5  # best-of-5 inside one job (single-core hosts are noisy)
+REPS = 8  # best-of-N inside one job (single-core hosts are noisy)
 
 _FAST_RETRY = {
     "retry_policy": {
