@@ -1,0 +1,65 @@
+"""Flagship-model training throughput on the local accelerator.
+
+Measures tokens/second for the transformer LM train step (bf16 compute,
+f32 params/optimizer) at a configurable size — the party-local compute
+half of federated training, complementing the cross-party transport
+benchmarks.
+
+Usage: python benchmarks/transformer_train_benchmark.py [d_model] [layers] [seq]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=False):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from rayfed_tpu.models import transformer as tfm
+    from rayfed_tpu.parallel import sharding as shd
+    from rayfed_tpu.parallel.train import make_fed_train_step
+
+    cfg = tfm.TransformerConfig(
+        vocab=8192, d_model=d_model, n_heads=max(4, d_model // 64),
+        n_layers=n_layers, d_ff=int(d_model * 2.75) // 16 * 16,
+    )
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices).reshape(len(devices)), ("data",))
+    init_fn, step_fn = make_fed_train_step(
+        cfg, mesh, party_axis=None, data_axis="data", lr=1e-3, remat=remat
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, seq + 1), 0, cfg.vocab
+    )
+    sharding = NamedSharding(mesh, shd.batch_spec(mesh, party_axis=None))
+    inputs = jax.device_put(tokens[:, :-1], sharding)
+    targets = jax.device_put(tokens[:, 1:], sharding)
+    params, opt_state = init_fn(jax.random.PRNGKey(0), inputs)
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # Warmup/compile.
+    params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = steps * batch * seq / dt
+    print(
+        f"{jax.default_backend()} x{len(devices)}: {n_params/1e6:.1f}M params, "
+        f"batch {batch} x seq {seq}: {tok_s:,.0f} tokens/s "
+        f"({dt/steps*1000:.1f} ms/step), loss {loss:.3f}"
+    )
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:4]]
+    main(*args, remat=os.environ.get("REMAT", "0") == "1")

@@ -190,10 +190,13 @@ def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
 
 def forward(params: Params, tokens, cfg: TransformerConfig,
             attn_fn: Optional[AttnFn] = None,
-            positions=None) -> jax.Array:
+            positions=None, remat: bool = False) -> jax.Array:
     """tokens (B, S) int32 -> logits (B, S, vocab) float32.
 
     Layers run under one ``lax.scan`` over the stacked parameters.
+    ``remat=True`` checkpoints each layer (recompute activations in the
+    backward pass — HBM for FLOPs, the standard trade for deep/long
+    configs).
     """
     b, s = tokens.shape
     if positions is None:
@@ -203,17 +206,23 @@ def forward(params: Params, tokens, cfg: TransformerConfig,
     def body(x, layer):
         return layer_fn(x, layer, positions, cfg, attn_fn), None
 
+    if remat:
+        # prevent_cse=False: scan's loop semantics already block the CSE
+        # that checkpoint's default barriers guard against; leaving them on
+        # just costs XLA fusion opportunities.
+        body = jax.checkpoint(body, prevent_cse=False)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["ln_f"])
     return (x @ params["lm_head"].astype(cfg.compute_dtype)).astype(jnp.float32)
 
 
 def lm_loss_pair(params: Params, inputs, targets, cfg: TransformerConfig,
-                 attn_fn: Optional[AttnFn] = None) -> jax.Array:
+                 attn_fn: Optional[AttnFn] = None,
+                 remat: bool = False) -> jax.Array:
     """Next-token cross entropy over pre-shifted (inputs, targets) pairs,
     both (B, S) — the sharding-friendly form (S stays divisible by the seq
     axis; no in-jit slicing of sharded dims). f32 accumulation."""
-    logits = forward(params, inputs, cfg, attn_fn)
+    logits = forward(params, inputs, cfg, attn_fn, remat=remat)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return (logz - gold).mean()

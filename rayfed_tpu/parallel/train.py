@@ -42,6 +42,7 @@ def make_fed_train_step(
     data_axis: Optional[str] = "data",
     seq_axis: Optional[str] = None,
     lr: float = 3e-4,
+    remat: bool = False,
 ):
     """Build (init_fn, step_fn) jitted over ``mesh``.
 
@@ -75,7 +76,9 @@ def make_fed_train_step(
     batch_sharding = NamedSharding(mesh, batch_pspec)
 
     def loss_fn(params, inputs, targets):
-        return tfm.lm_loss_pair(params, inputs, targets, cfg, attn_fn)
+        return tfm.lm_loss_pair(
+            params, inputs, targets, cfg, attn_fn, remat=remat
+        )
 
     def step(params, opt_state, inputs, targets):
         loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets)

@@ -136,3 +136,25 @@ def test_fed_train_step_with_ring_seq_parallel():
         if i == 0:
             l0 = float(loss)
     assert np.isfinite(float(loss)) and float(loss) < l0
+
+
+def test_remat_matches_non_remat():
+    cfg = tfm.tiny_config(compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    base = tfm.lm_loss_pair(params, inputs, targets, cfg)
+    remat = tfm.lm_loss_pair(params, inputs, targets, cfg, remat=True)
+    np.testing.assert_allclose(float(remat), float(base), rtol=1e-6)
+    g_base = jax.grad(
+        lambda p: tfm.lm_loss_pair(p, inputs, targets, cfg)
+    )(params)
+    g_remat = jax.grad(
+        lambda p: tfm.lm_loss_pair(p, inputs, targets, cfg, remat=True)
+    )(params)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_base), jax.tree_util.tree_leaves(g_remat)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
