@@ -1,0 +1,119 @@
+"""Cross-party push latency/throughput sweep, 1KB -> 100MB
+(BASELINE.json config #2's full payload range).
+
+Prints one line per size per transport: median round-trip of
+produce-at-alice -> consume-at-bob, and effective GB/s for the large sizes.
+
+Usage: python benchmarks/push_size_sweep.py [transports...]
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import socket
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SIZES = [2**10, 2**14, 2**17, 2**20, 2**23, 10 * 2**20, 100 * 2**20]
+REPS = {2**10: 50, 2**14: 50, 2**17: 30, 2**20: 20, 2**23: 10,
+        10 * 2**20: 8, 100 * 2**20: 5}
+
+
+def _party_main(party, addresses, transport, result_path):
+    import numpy as np
+
+    import rayfed_tpu as fed
+
+    fed.init(
+        addresses=addresses, party=party,
+        config={"cross_silo_comm": {
+            "retry_policy": {"max_attempts": 20, "initial_backoff_ms": 200}},
+            "transport": transport},
+        job_name=f"sweep-{transport}", logging_level="error",
+    )
+
+    @fed.remote
+    def produce(nbytes, tag):
+        return np.full((int(nbytes) // 4,), float(tag), dtype=np.float32)
+
+    @fed.remote
+    def consume(x):
+        return float(x[-1])
+
+    results = {}
+    tag = 0.0
+    for nbytes in SIZES:
+        # Warmup.
+        tag += 1
+        assert fed.get(consume.party("bob").remote(
+            produce.party("alice").remote(nbytes, tag))) == tag
+        times = []
+        for _ in range(REPS[nbytes]):
+            tag += 1
+            t0 = time.perf_counter()
+            v = fed.get(consume.party("bob").remote(
+                produce.party("alice").remote(nbytes, tag)))
+            times.append(time.perf_counter() - t0)
+            assert v == tag
+        med = sorted(times)[len(times) // 2]
+        results[nbytes] = {
+            "median_ms": med * 1000,
+            "gbps": nbytes / (1 << 30) / med,
+        }
+    if party == "bob":
+        with open(result_path, "w") as f:
+            json.dump(results, f)
+    fed.shutdown()
+
+
+def run(transport):
+    socks = [socket.socket() for _ in range(2)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    addresses = {p: f"127.0.0.1:{s.getsockname()[1]}"
+                 for p, s in zip(("alice", "bob"), socks)}
+    for s in socks:
+        s.close()
+    mp = multiprocessing.get_context("spawn")
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "r.json")
+        procs = [mp.Process(target=_party_main,
+                            args=(p, addresses, transport, path))
+                 for p in ("alice", "bob")]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=600)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                raise RuntimeError("sweep party hung")
+        with open(path) as f:
+            return json.load(f)
+
+
+def fmt_size(n):
+    if n >= 2**20:
+        return f"{n // 2**20}MB"
+    return f"{n // 2**10}KB"
+
+
+def main(transports):
+    for transport in transports:
+        results = run(transport)
+        for nbytes in SIZES:
+            r = results[str(nbytes)]
+            line = (f"{transport:>5} {fmt_size(nbytes):>6}: "
+                    f"{r['median_ms']:8.2f} ms median")
+            if nbytes >= 2**20:
+                line += f"  ({r['gbps']:.3f} GB/s)"
+            print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or ["tcp", "grpc"])
