@@ -114,6 +114,10 @@ def make_pp_loss_fn(
         in_specs=(stage_spec_leaves, rep, rep, rep, rep, rep),
         out_specs=rep,
         check_vma=False,
+        # Manual over the stage axis only: any other mesh axes (model/data)
+        # stay GSPMD-automatic, so TP-sharded params and DP-sharded batches
+        # keep their shardings inside the pipeline body.
+        axis_names={stage_axis},
     )
 
     def loss_fn(params, inputs, targets):
