@@ -19,7 +19,6 @@ from __future__ import annotations
 import logging
 import os
 import signal
-import threading
 from typing import Callable, Optional
 
 from rayfed_tpu._private.message_queue import MessageQueueManager

@@ -18,7 +18,7 @@ is serialized.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from rayfed_tpu._private.call_holder import FedCallHolder
 from rayfed_tpu._private.global_context import get_global_context

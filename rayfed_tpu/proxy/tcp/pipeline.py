@@ -176,6 +176,14 @@ class PipelinedLane:
                 return True
             try:
                 sock = self._ensure_conn()
+            except (OSError, ConnectionError) as e:
+                # The full connect budget is exhausted: the peer is gone.
+                # Fail every unacked frame NOW — retrying forever would
+                # leave their futures unresolved, wedging the cleanup
+                # drain and any exit_on_sending_failure escalation.
+                self._fail_all_inflight(e)
+                return False
+            try:
                 for job in pending:
                     job.attempts += 1
                     job.sent_at = time.monotonic()
@@ -186,6 +194,25 @@ class PipelinedLane:
             except (OSError, ConnectionError) as e:
                 self._handle_break(e)
         return False
+
+    def _fail_all_inflight(self, err: Exception) -> None:
+        with self._lock:
+            self._broken = True
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            jobs = list(self._inflight)
+            self._inflight.clear()
+        for job in jobs:
+            self._window.release()
+            job.out.set_exception(
+                ConnectionError(
+                    f"peer {self._dest} unreachable with frame in flight: {err}"
+                )
+            )
 
     def _tick(self) -> None:
         """Idle housekeeping: ack timeouts and broken-connection resends."""

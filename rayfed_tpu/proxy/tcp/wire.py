@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import ssl
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
 import msgpack
 

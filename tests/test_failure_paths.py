@@ -164,3 +164,78 @@ def run_barrier(party, addresses):
 
 def test_ping_others_barrier():
     run_parties(run_barrier, ["alice", "bob"])
+
+
+def run_victim(party, addresses, q):
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": {
+                "retry_policy": {
+                    "max_attempts": 3,
+                    "initial_backoff_ms": 100,
+                    "max_backoff_ms": 300,
+                },
+                "timeout_in_ms": 4000,
+                "recv_timeout_in_ms": 8000,
+                "exit_on_sending_failure": True,
+            }
+        },
+        sending_failure_handler=lambda e: q.put("handler-fired"),
+    )
+
+    @fed.remote
+    def stream(i):
+        import numpy as np
+
+        return np.full((1 << 20,), float(i), dtype=np.float32)
+
+    @fed.remote
+    def sink(x):
+        if party == "bob" and float(x[0]) == 1.0:
+            import os
+
+            os._exit(17)  # simulate a hard crash mid-stream
+        return float(x[0])
+
+    import time
+
+    crashed = False
+    for i in range(8):
+        # Keep pushing even after the crash is detected: the failing pushes
+        # are what drive the drain thread's exit signal on alice.
+        out = sink.party("bob").remote(stream.party("alice").remote(float(i)))
+        if not crashed:
+            try:
+                fed.get(out)
+            except Exception:
+                crashed = True
+        time.sleep(0.2)
+    time.sleep(60)  # SIGINT from drain interrupts (alice) after bob dies
+    fed.shutdown()
+
+
+def test_peer_crash_mid_stream_is_detected():
+    """Bob hard-crashes (os._exit) mid-run: alice's pipelined sends fail
+    after the reconnect budget, the failure handler fires, and alice exits
+    1 instead of hanging."""
+    addresses = get_addresses(["alice", "bob"])
+    q = multiprocessing.get_context("spawn").Queue()
+    alice = MP.Process(target=run_victim, args=("alice", addresses, q))
+    bob = MP.Process(target=run_victim, args=("bob", addresses, q))
+    try:
+        alice.start()
+        bob.start()
+        bob.join(timeout=120)
+        assert bob.exitcode == 17, bob.exitcode
+        alice.join(timeout=120)
+        assert alice.exitcode == 1, alice.exitcode
+        assert q.get(timeout=10) == "handler-fired"
+    finally:
+        # A failed assert must not wedge pytest behind live non-daemon
+        # children (multiprocessing joins them at interpreter exit).
+        for p in (alice, bob):
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=30)
