@@ -38,19 +38,32 @@ def _gates(params: Params, x, top1: bool):
     logits = x @ params["router"]  # (..., E)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     if top1:
-        best = probs.max(axis=-1, keepdims=True)
-        probs = jnp.where(probs == best, probs, 0.0)
+        # argmax, not probs==max: a max-comparison can select TWO experts
+        # on low-precision ties, which desyncs the dense and a2a lanes.
+        mask = jax.nn.one_hot(
+            jnp.argmax(probs, axis=-1), probs.shape[-1], dtype=probs.dtype
+        )
+        probs = probs * mask
     return probs.astype(x.dtype)
 
 
+def _expert_ffn(w_up, w_down, toks):
+    """THE per-expert FFN core: toks (E, T, d) -> (E, T, d). Every lane
+    (dense, expert-parallel, all-to-all) routes through this one function —
+    they must never diverge (the *_matches_dense tests pin equivalence)."""
+    up = jnp.einsum("etd,edf->etf", toks, w_up)
+    return jnp.einsum("etf,efd->etd", jax.nn.gelu(up), w_down)
+
+
 def _expert_ffn_combine(w_up, w_down, x, gates):
-    """Shared FFN math: run `E_local` experts on all tokens, gate-combine.
-    Both the dense and the expert-parallel paths call this — they must
-    never diverge (test_ep_moe_matches_dense pins the equivalence)."""
-    up = jnp.einsum("...d,edf->...ef", x, w_up)
-    act = jax.nn.gelu(up)
-    out = jnp.einsum("...ef,efd->...ed", act, w_down)
-    return jnp.einsum("...ed,...e->...d", out, gates)
+    """Run all experts on all tokens and gate-combine (dense/EP lanes)."""
+    e = w_up.shape[0]
+    flat = x.reshape(-1, x.shape[-1])
+    toks = jnp.broadcast_to(flat, (e,) + flat.shape)
+    out = _expert_ffn(w_up, w_down, toks)          # (E, N, d)
+    flat_gates = gates.reshape(-1, gates.shape[-1])
+    combined = jnp.einsum("end,ne->nd", out, flat_gates)
+    return combined.reshape(x.shape)
 
 
 def moe_ffn_apply(params: Params, x, top1: bool = True):
@@ -84,5 +97,79 @@ def make_ep_moe_apply(mesh: Mesh, expert_axis: str = "expert"):
         mesh=mesh,
         in_specs=(e_spec, P()),
         out_specs=P(),
+        check_vma=False,
+    )
+
+
+def make_a2a_moe_apply(mesh: Mesh, expert_axis: str = "expert",
+                       capacity_factor: float = 1.25):
+    """Capacity-based all-to-all expert dispatch (switch-style) — the
+    scalable EP form: tokens are sharded over the expert axis, each device
+    selects up to C tokens per expert, one ``all_to_all`` routes them to
+    their expert's device, the FFN runs on E_local experts, and a second
+    ``all_to_all`` routes results home. Compute per device is
+    O(E_local * C) instead of the dense path's O(E * N); tokens over an
+    expert's capacity are dropped (output zero), the standard trade.
+
+    Call with token-sharded x of shape (N, d) — N divisible by the axis
+    size — and full-size expert params; returns (N, d).
+    """
+    n_dev = mesh.shape[expert_axis]
+
+    def body(params, x):
+        n_local, d = x.shape
+        e_local = params["w_up"].shape[0]
+        n_experts = e_local * n_dev
+        capacity = max(1, int(n_local * capacity_factor / n_experts))
+
+        gates = _gates(params, x, top1=True)          # (N_local, E) one-hot-ish
+        # Ranks MUST accumulate in int32: a low-precision cumsum (bf16 has
+        # an 8-bit mantissa) silently collides tokens onto the same slot
+        # once ranks exceed the dtype's exact-integer range.
+        onehot_i = (gates > 0).astype(jnp.int32)       # (N_local, E)
+        gate_val = gates.sum(axis=-1)                  # (N_local,)
+
+        # Rank of each token within its expert's queue; drop overflow.
+        pos = jnp.cumsum(onehot_i, axis=0) * onehot_i  # 1-based ranks
+        keep = (pos > 0) & (pos <= capacity)
+        loc = jnp.clip(pos - 1, 0, capacity - 1)
+
+        # (N_local, E, C) dispatch tensor.
+        loc_onehot = jax.nn.one_hot(loc, capacity, dtype=x.dtype)
+        dispatch = (
+            keep.astype(x.dtype)[..., None] * loc_onehot
+        )                                              # (N, E, C)
+
+        # Scatter tokens into per-expert slots, then route slots to the
+        # expert's device: (E, C, d) -> (n_dev, e_local, C, d) a2a.
+        slots = jnp.einsum("nec,nd->ecd", dispatch, x)
+        slots = slots.reshape(n_dev, e_local, capacity, d)
+        recv = lax.all_to_all(
+            slots, expert_axis, split_axis=0, concat_axis=0, tiled=False
+        )                                              # (n_dev, e_local, C, d)
+
+        # Local experts run on tokens gathered from every device.
+        toks = jnp.moveaxis(recv, 1, 0).reshape(
+            e_local, n_dev * capacity, d
+        )
+        out = _expert_ffn(params["w_up"], params["w_down"], toks)
+
+        # Route results back to the tokens' home devices.
+        back = jnp.moveaxis(
+            out.reshape(e_local, n_dev, capacity, d), 1, 0
+        )                                              # (n_dev, e_local, C, d)
+        home = lax.all_to_all(
+            back, expert_axis, split_axis=0, concat_axis=0, tiled=False
+        ).reshape(n_experts, capacity, d)
+
+        combined = jnp.einsum("nec,ecd->nd", dispatch, home)
+        return combined * gate_val[:, None]
+
+    e_spec = {"router": P(), "w_up": P(expert_axis), "w_down": P(expert_axis)}
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(e_spec, P(expert_axis)),
+        out_specs=P(expert_axis),
         check_vma=False,
     )

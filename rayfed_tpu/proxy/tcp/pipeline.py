@@ -72,6 +72,11 @@ class PipelinedLane:
         self._sock: Optional[socket.socket] = None
         self._broken = True
         self._closed = False
+        # Set once a full connect budget failed: subsequent frames probe
+        # with a single connect attempt (fast-fail for a queued backlog to
+        # a dead peer) instead of each burning the whole budget; any
+        # successful connect clears it, so a recovered peer resumes.
+        self._peer_down = False
         self._reader_gen = 0
         self._writer = threading.Thread(
             target=self._writer_loop, name=f"fedtpu-pipe-w-{dest}", daemon=True
@@ -154,10 +159,19 @@ class PipelinedLane:
         with self._lock:
             if self._sock is not None and not self._broken:
                 return self._sock
-        sock = self._connect(None)  # full retry budget
+            probe_only = self._peer_down
+        try:
+            # Probe with a small budget (not 1): a lone attempt landing in
+            # a transient blip of a *recovered* peer would spuriously fail
+            # the frame — and possibly escalate via exit_on_sending_failure.
+            sock = self._connect(2 if probe_only else None)
+        except (OSError, ConnectionError):
+            self._peer_down = True
+            raise
         with self._lock:
             self._sock = sock
             self._broken = False
+            self._peer_down = False
             self._reader_gen += 1
             gen = self._reader_gen
         threading.Thread(
