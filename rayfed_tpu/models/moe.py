@@ -34,9 +34,16 @@ def init_moe_ffn(rng, d_model: int, d_ff: int, n_experts: int,
     }
 
 
-def _gates(params: Params, x, top1: bool):
+def _router_probs(params: Params, x):
+    """Router probabilities in float32 — THE routing numerics, shared by
+    every gating variant (top-1, top-k, aux loss): changes to temperature,
+    z-loss scaling etc. belong here and nowhere else."""
     logits = x @ params["router"]  # (..., E)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def _gates(params: Params, x, top1: bool):
+    probs = _router_probs(params, x)
     if top1:
         # argmax, not probs==max: a max-comparison can select TWO experts
         # on low-precision ties, which desyncs the dense and a2a lanes.
@@ -173,3 +180,38 @@ def make_a2a_moe_apply(mesh: Mesh, expert_axis: str = "expert",
         out_specs=P(expert_axis),
         check_vma=False,
     )
+
+
+def topk_gates(params: Params, x, k: int = 2):
+    """Top-k routing: per token, the k best experts with their softmax
+    probabilities renormalized to sum to 1. Returns (..., E) gates."""
+    probs = _router_probs(params, x)
+    _, idx = lax.top_k(probs, k)
+    mask = jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype).sum(axis=-2)
+    kept = probs * mask
+    kept = kept / jnp.maximum(kept.sum(axis=-1, keepdims=True), 1e-9)
+    return kept.astype(x.dtype)
+
+
+def load_balance_loss(params: Params, x, k: int = 1):
+    """Switch-transformer auxiliary load-balancing loss:
+    E * sum_e f_e * P_e, where f_e is the fraction of routed assignments
+    landing on expert e (over the same top-k choices the gating uses — an
+    aux loss that only watches top-1 would let every second choice collapse
+    onto one expert unpenalized) and P_e the mean router probability.
+    Minimized (-> 1.0) by a uniform distribution; add a small multiple to
+    the task loss when training MoE models so experts stay utilized. Pass
+    the same ``k`` as the gating in use."""
+    probs = _router_probs(params, x.reshape(-1, x.shape[-1]))
+    n_experts = probs.shape[-1]
+    _, idx = lax.top_k(probs, k)
+    chosen = jax.nn.one_hot(idx, n_experts, dtype=jnp.float32).sum(axis=-2)
+    f = chosen.mean(axis=0) / k   # fraction of assignments per expert
+    p = probs.mean(axis=0)        # mean router probability per expert
+    return n_experts * jnp.sum(f * p)
+
+
+def moe_ffn_apply_topk(params: Params, x, k: int = 2):
+    """Dense-compute forward with top-k routing (k experts per token)."""
+    gates = topk_gates(params, x, k)
+    return _expert_ffn_combine(params["w_up"], params["w_down"], x, gates)

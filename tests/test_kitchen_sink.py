@@ -1,0 +1,77 @@
+"""Everything-on integration: mutual TLS + strict arrays-only wire +
+TPU transport with party meshes + recv deadlines + tracing, in one
+two-party federated training job — the hardened production configuration
+exercised end-to-end."""
+
+import os
+import sys
+
+import numpy as np
+
+import rayfed_tpu as fed
+from tests.utils import FAST_COMM_CONFIG, run_parties
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.generate_tls_certs import generate, tls_config_for  # noqa: E402
+
+
+def run_hardened(party, addresses, cert_dir):
+    from rayfed_tpu import tracing
+
+    tracing.enable()
+    device_ids = {"alice": [0, 1, 2, 3], "bob": [4, 5, 6, 7]}[party]
+    fed.init(
+        addresses=addresses,
+        party=party,
+        tls_config=tls_config_for(cert_dir, party),
+        config={
+            "cross_silo_comm": {
+                **FAST_COMM_CONFIG,
+                "allow_pickle_payloads": False,
+                "recv_timeout_in_ms": 60000,
+            },
+            "transport": "tpu",
+            "party_mesh": {"device_ids": device_ids, "axis_names": ["data"]},
+        },
+    )
+
+    import jax
+
+    from rayfed_tpu.ops.aggregate import tree_mean
+
+    @fed.remote
+    class Worker:
+        def __init__(self, seed):
+            rng = np.random.default_rng(seed)
+            self.w = {"w": rng.normal(size=(64, 8)).astype(np.float32)}
+
+        def train(self, global_w):
+            if global_w is not None:
+                self.w = jax.tree_util.tree_map(np.asarray, global_w)
+            self.w = {"w": self.w["w"] * 0.9}
+            return self.w
+
+    @fed.remote
+    def fedavg(a, b):
+        return tree_mean(a, b)
+
+    workers = {p: Worker.party(p).remote(seed=i)
+               for i, p in enumerate(["alice", "bob"])}
+    global_w = None
+    for _ in range(3):
+        locals_ = {p: workers[p].train.remote(global_w) for p in workers}
+        global_w = fedavg.party("alice").remote(locals_["alice"],
+                                                locals_["bob"])
+    final = fed.get(global_w)
+    assert np.isfinite(np.asarray(final["w"])).all()
+    # Transfers really happened over the TLS strict wire.
+    spans = tracing.get_spans("send")
+    assert spans and all(s.ok for s in spans)
+    fed.shutdown()
+
+
+def test_hardened_configuration_end_to_end(tmp_path):
+    cert_dir = str(tmp_path / "certs")
+    generate(cert_dir, ["alice", "bob"])
+    run_parties(run_hardened, ["alice", "bob"], extra_args=(cert_dir,),
+                timeout=240)

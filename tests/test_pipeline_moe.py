@@ -233,3 +233,40 @@ def test_a2a_moe_bf16_tokens_route_consistently():
     )
     mismatched = (np.abs(got - dense).max(axis=-1) > 0.1).mean()
     assert mismatched < 0.01, f"{mismatched:.2%} tokens mismatched"
+
+
+def test_topk_gates_and_loss():
+    from rayfed_tpu.models.moe import (
+        load_balance_loss,
+        moe_ffn_apply_topk,
+        topk_gates,
+    )
+
+    d, f, e = 8, 16, 4
+    params = init_moe_ffn(jax.random.PRNGKey(6), d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(7), (32, d))
+    g = np.asarray(topk_gates(params, x, k=2))
+    # Exactly two experts per token, gates normalized.
+    assert ((g > 0).sum(axis=-1) == 2).all()
+    np.testing.assert_allclose(g.sum(axis=-1), 1.0, rtol=1e-5)
+    # k = E degenerates to the full softmax (already normalized).
+    g_all = np.asarray(topk_gates(params, x, k=e))
+    assert ((g_all > 0).sum(axis=-1) == e).all()
+
+    out = moe_ffn_apply_topk(params, x, k=2)
+    assert out.shape == x.shape and bool(jnp.isfinite(out).all())
+
+    # Aux loss: >= 1 always; == 1 under a perfectly uniform router.
+    lb = float(load_balance_loss(params, x))
+    lb2 = float(load_balance_loss(params, x, k=2))
+    assert lb2 >= 1.0 - 1e-6, lb2
+    assert lb >= 1.0 - 1e-6, lb
+    uniform = dict(params, router=jnp.zeros_like(params["router"]))
+    # Zero logits -> uniform probs; f depends on argmax ties (all index 0),
+    # so only P is uniform: E * sum(f * 1/E) == 1 regardless of f.
+    np.testing.assert_allclose(
+        float(load_balance_loss(uniform, x)), 1.0, rtol=1e-5
+    )
+    # Differentiable.
+    grad = jax.grad(lambda p: load_balance_loss(p, x))(params)
+    assert bool(jnp.isfinite(grad["router"]).all())
