@@ -1,0 +1,76 @@
+"""Driver-contract regression tests for ``__graft_entry__.py``.
+
+The driver imports ``__graft_entry__`` and calls ``dryrun_multichip(n)``
+directly — possibly in a process where jax already came up on the real
+single-chip TPU platform (round-1 failure mode: ``MULTICHIP_r01.json``
+``ok=false`` because the 8-device CPU sim was only forced under
+``__main__``).  These tests exercise exactly that call path: a fresh
+subprocess whose environment is NOT scrubbed (``PALLAS_AXON_POOL_IPS``
+left alone, no ``JAX_PLATFORMS`` override), which imports jax first and
+then calls ``dryrun_multichip``.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dirty_env():
+    """An env like the driver's: no CPU forcing, no device-count flag."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("_RAYFED_TPU_DRYRUN_CHILD", None)
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f
+        for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    if flags:
+        env["XLA_FLAGS"] = flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_dryrun_multichip_under_driver_conditions():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.devices(); "  # driver may touch jax first
+            "import __graft_entry__; "
+            "__graft_entry__.dryrun_multichip(8)",
+        ],
+        cwd=REPO,
+        env=_dirty_env(),
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "dryrun_multichip OK" in proc.stdout, proc.stdout
+
+
+def test_entry_compiles_and_runs():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax, numpy as np\n"
+            "import __graft_entry__\n"
+            "fn, args = __graft_entry__.entry()\n"
+            "out = jax.jit(fn)(*args)\n"
+            "assert np.all(np.isfinite(np.asarray(out))), 'non-finite'\n"
+            "print('ENTRY OK', out.shape)",
+        ],
+        cwd=REPO,
+        env=_dirty_env(),
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "ENTRY OK" in proc.stdout, proc.stdout
