@@ -30,6 +30,7 @@ WIRE_VERSION = 1
 # Response codes on the data plane — kept numerically compatible with the
 # reference's HTTP-flavored codes (``fed/proxy/grpc/grpc_proxy.py:311-320``).
 CODE_OK = 200
+CODE_FORBIDDEN = 403  # peer cert does not attest the claimed src party
 CODE_PICKLE_FORBIDDEN = 415  # strict arrays-only mode rejected the frame
 CODE_JOB_MISMATCH = 417
 CODE_INTERNAL_ERROR = 500

@@ -79,6 +79,11 @@ def reset_config_cache() -> None:
     _job_config = None
 
 
+# Receive-path payload cap applied when messages_max_size_in_bytes is
+# unset — parity with the reference's gRPC default (grpc_options.py:28-29).
+DEFAULT_MAX_MESSAGE_BYTES = 500 * 1024 * 1024
+
+
 @dataclasses.dataclass
 class CrossSiloMessageConfig:
     """Transport-independent cross-party messaging knobs
@@ -98,8 +103,12 @@ class CrossSiloMessageConfig:
             vanishes before pushing (no error envelope can cross a dead
             transport — improvement over the reference, which can only
             hang in that case).
-        messages_max_size_in_bytes: max payload size; None = unlimited
-            (the reference caps gRPC at 500MB, grpc_options.py:28-29).
+        messages_max_size_in_bytes: max payload size. None (default)
+            applies the 500MB cap the reference uses for gRPC
+            (grpc_options.py:28-29) — on every lane, so an unauthenticated
+            peer cannot make the receiver allocate arbitrarily large
+            buffers. A non-positive value disables the cap (the 1 TiB
+            wire sanity cap still applies).
         serializing_allowed_list: {module: [class, ...]} whitelist for
             unpickling received non-array payloads.
         allow_pickle_payloads: False = strict arrays-only mode — the
@@ -122,6 +131,15 @@ class CrossSiloMessageConfig:
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False
+
+    def effective_max_message_bytes(self) -> Optional[int]:
+        """The payload cap actually enforced on send and receive paths:
+        configured value, or 500MB when unset; None (no cap) only when the
+        user explicitly configures a non-positive value."""
+        v = self.messages_max_size_in_bytes
+        if v is None:
+            return DEFAULT_MAX_MESSAGE_BYTES
+        return None if v <= 0 else v
 
     def __json__(self) -> str:
         import json
@@ -179,10 +197,21 @@ class RetryPolicy:
 class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
     """Knobs specific to the native TCP transport (our default data plane,
     replacing the reference's gRPC channel options,
-    ref ``fed/config.py:164-195``)."""
+    ref ``fed/config.py:164-195``).
+
+    Attributes:
+        verify_peer_identity: under mutual TLS, require the sender's
+            certificate (subject CN or a DNS SAN) to attest the ``src``
+            party it claims in each frame; mismatches are rejected with
+            code 403. Party certs from ``tools/generate_tls_certs.py``
+            carry the party name as CN. Set False for deployments whose
+            certs are host-named rather than party-named (those fall back
+            to plain shared-CA trust).
+    """
 
     retry_policy: Optional[Dict[str, Any]] = None
     connect_timeout_in_ms: int = 10000
+    verify_peer_identity: bool = True
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)

@@ -40,16 +40,13 @@ _SERVICE = "rayfed_tpu.GrpcService"
 _SEND_DATA = "SendData"
 _METHOD_PATH = f"/{_SERVICE}/{_SEND_DATA}"
 
-_DEFAULT_MAX_MSG = 500 * 1024 * 1024  # parity: grpc_options.py:28-29
-
-
 def _identity(b: bytes) -> bytes:
     return b
 
 
 def _channel_options(config: TcpCrossSiloMessageConfig):
     policy = config.get_retry_policy()
-    max_msg = config.messages_max_size_in_bytes or _DEFAULT_MAX_MSG
+    max_msg = config.effective_max_message_bytes() or -1  # -1: gRPC unlimited
     retry = {
         "maxAttempts": policy.max_attempts,
         "initialBackoff": f"{policy.initial_backoff_ms / 1000}s",
@@ -205,7 +202,7 @@ class GrpcReceiverProxy(ReceiverProxy):
         recv_timeout = self._config.recv_timeout_in_ms
         self._store = RendezvousStore(
             job_name, decode,
-            max_payload_bytes=self._config.messages_max_size_in_bytes,
+            max_payload_bytes=self._config.effective_max_message_bytes(),
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
             allow_pickle=self._config.allow_pickle_payloads,
         )
@@ -230,7 +227,7 @@ class GrpcReceiverProxy(ReceiverProxy):
                 )
             },
         )
-        max_msg = self._config.messages_max_size_in_bytes or _DEFAULT_MAX_MSG
+        max_msg = self._config.effective_max_message_bytes() or -1
         self._server = grpc.server(
             ThreadPoolExecutor(max_workers=8, thread_name_prefix="fedtpu-grpc-recv"),
             options=[

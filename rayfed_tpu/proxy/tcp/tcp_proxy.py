@@ -34,7 +34,11 @@ from typing import Dict, Optional, Tuple
 
 from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
-from rayfed_tpu._private.constants import CODE_INTERNAL_ERROR, CODE_OK
+from rayfed_tpu._private.constants import (
+    CODE_FORBIDDEN,
+    CODE_INTERNAL_ERROR,
+    CODE_OK,
+)
 from rayfed_tpu.config import TcpCrossSiloMessageConfig
 from rayfed_tpu.exceptions import FedLocalError
 from rayfed_tpu.proxy import rendezvous
@@ -216,13 +220,11 @@ class _DestWorker(threading.Thread):
                 "(strict arrays-only mode): send pytrees of arrays/scalars"
             )
         payload_len = sum(serialization.buffer_nbytes(b) for b in buffers)
-        if (
-            cfg.messages_max_size_in_bytes is not None
-            and payload_len > cfg.messages_max_size_in_bytes
-        ):
+        max_bytes = cfg.effective_max_message_bytes()
+        if max_bytes is not None and payload_len > max_bytes:
             raise ValueError(
-                f"payload of {payload_len} bytes exceeds "
-                f"messages_max_size_in_bytes={cfg.messages_max_size_in_bytes}"
+                f"payload of {payload_len} bytes exceeds the effective "
+                f"messages_max_size_in_bytes={max_bytes}"
             )
         header = {
             "job": self._proxy._job_name,
@@ -340,7 +342,7 @@ class TcpReceiverProxy(ReceiverProxy):
         self._store = RendezvousStore(
             job_name,
             self._make_decode_fn(),
-            max_payload_bytes=self._config.messages_max_size_in_bytes,
+            max_payload_bytes=self._config.effective_max_message_bytes(),
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
             allow_pickle=self._config.allow_pickle_payloads,
         )
@@ -435,15 +437,20 @@ class TcpReceiverProxy(ReceiverProxy):
     def _serve_conn(self, conn: socket.socket, peer, ssl_ctx) -> None:
         try:
             sockio.tune_socket(conn)
+            peer_ids = None
             if ssl_ctx is not None:
                 conn = ssl_ctx.wrap_socket(conn, server_side=True)
+                if self._config.verify_peer_identity:
+                    # Fail closed: a cert attesting no identities (or
+                    # unreadable cert info) rejects every src claim.
+                    peer_ids = wire.peer_party_identities(conn) or set()
             with self._conn_lock:
                 self._open_conns.add(conn)
             while not self._stopping:
                 try:
                     ftype, header, payload = sockio.recv_frame(
                         conn,
-                        max_payload=self._config.messages_max_size_in_bytes,
+                        max_payload=self._config.effective_max_message_bytes(),
                     )
                 except (ConnectionError, OSError):
                     return
@@ -457,6 +464,22 @@ class TcpReceiverProxy(ReceiverProxy):
                         conn, wire.FTYPE_RESP,
                         {"code": CODE_INTERNAL_ERROR,
                          "msg": "expected DATA frame"},
+                    )
+                    continue
+                if peer_ids is not None and header.get("src") not in peer_ids:
+                    # mTLS party binding: a CA-signed peer must not be able
+                    # to impersonate another party's sends.
+                    logger.warning(
+                        "rejecting frame from %s: claimed src=%r not attested "
+                        "by peer certificate identities %s",
+                        peer, header.get("src"), sorted(peer_ids),
+                    )
+                    sockio.send_frame(
+                        conn, wire.FTYPE_RESP,
+                        {"code": CODE_FORBIDDEN,
+                         "msg": "peer certificate does not attest claimed "
+                                "src party",
+                         "fseq": header.get("fseq")},
                     )
                     continue
                 code, msg = self._store.offer(header, payload)

@@ -92,6 +92,35 @@ def make_server_ssl_context(tls_config: Dict) -> ssl.SSLContext:
     return ctx
 
 
+def peer_party_identities(ssl_sock) -> Optional[set]:
+    """Identities (subject CN values + DNS SANs) attested by the peer's
+    verified certificate, or None when no cert info is available.
+
+    Used to bind the mTLS layer to the claimed ``src`` party: without this,
+    any CA-signed party could impersonate another party's sends (all certs
+    chain to the shared CA; ``check_hostname`` is off because party certs
+    are named per party, not per host).
+
+    Returns an EMPTY set — not None — when a cert is present but names no
+    identity, so the caller fails closed (every src claim rejected) rather
+    than open. None means no cert information was available at all."""
+    try:
+        cert = ssl_sock.getpeercert()
+    except (ssl.SSLError, OSError, ValueError):
+        return None
+    if not cert:
+        return None
+    ids = set()
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                ids.add(value)
+    for typ, value in cert.get("subjectAltName", ()):
+        if typ == "DNS":
+            ids.add(value)
+    return ids
+
+
 def make_client_ssl_context(tls_config: Dict) -> ssl.SSLContext:
     _check_tls_config(tls_config)
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)

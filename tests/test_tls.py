@@ -86,3 +86,69 @@ def test_certless_client_rejected(tmp_path):
     assert rejected, "server accepted a cert-less TLS client"
     tls.close()
     rp.stop()
+
+
+def test_peer_cert_must_attest_claimed_src_party(tmp_path):
+    """mTLS party binding (ADVICE r1): a CA-signed peer whose certificate
+    names one party cannot push frames claiming to be another party."""
+    from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
+
+    cert_dir = str(tmp_path / "certs")
+    generate(cert_dir, ["alice", "bob", "carol"])
+    addr = get_addresses(["bob"])
+    fast = dict(FAST_COMM_CONFIG)
+    rp = TcpReceiverProxy(
+        addr["bob"], "bob", "job", tls_config_for(cert_dir, "bob"), fast
+    )
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+
+    # Impersonation: the sender presents carol's cert but claims src=alice.
+    impostor = TcpSenderProxy(
+        addr, "alice", "job", tls_config_for(cert_dir, "carol"), fast
+    )
+    impostor.start()
+    fut = impostor.send("bob", np.ones(8, np.float32), "1#0", 2)
+    with pytest.raises(RuntimeError, match="403"):
+        fut.result(timeout=60)
+    # Nothing may have been buffered for the waiter.
+    parked = rp.get_data("alice", "1#0", 2)
+    assert not parked.done()
+    impostor.stop()
+
+    # Control: the honest alice cert passes.
+    honest = TcpSenderProxy(
+        addr, "alice", "job", tls_config_for(cert_dir, "alice"), fast
+    )
+    honest.start()
+    assert honest.send("bob", np.ones(8, np.float32), "1#0", 2).result(
+        timeout=60
+    )
+    assert parked.result(timeout=60)[0] == 1.0
+    honest.stop()
+    rp.stop()
+
+
+def test_peer_identity_check_can_be_disabled(tmp_path):
+    from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
+
+    cert_dir = str(tmp_path / "certs")
+    generate(cert_dir, ["alice", "bob"])
+    addr = get_addresses(["bob"])
+    cfg = dict(FAST_COMM_CONFIG, verify_peer_identity=False)
+    rp = TcpReceiverProxy(
+        addr["bob"], "bob", "job", tls_config_for(cert_dir, "bob"), cfg
+    )
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    sp = TcpSenderProxy(
+        addr, "carol", "job", tls_config_for(cert_dir, "alice"), cfg
+    )
+    sp.start()
+    fut = rp.get_data("carol", "1#0", 2)
+    assert sp.send("bob", np.ones(4, np.float32), "1#0", 2).result(timeout=60)
+    assert fut.result(timeout=60)[0] == 1.0
+    sp.stop()
+    rp.stop()

@@ -217,3 +217,46 @@ def test_strict_mode_rejects_grpc_transport():
     from rayfed_tpu._private.global_context import get_global_context
 
     assert get_global_context() is None
+
+
+def test_default_receive_cap_is_500mb():
+    """ADVICE r1: an unauthenticated peer must not be able to make the
+    receiver allocate arbitrarily large buffers — with no explicit
+    messages_max_size_in_bytes the effective cap is 500MB (gRPC parity)."""
+    import socket
+
+    from rayfed_tpu.config import (
+        DEFAULT_MAX_MESSAGE_BYTES,
+        TcpCrossSiloMessageConfig,
+    )
+    from rayfed_tpu.proxy.tcp import wire
+
+    cfg = TcpCrossSiloMessageConfig()
+    assert cfg.effective_max_message_bytes() == DEFAULT_MAX_MESSAGE_BYTES
+    assert TcpCrossSiloMessageConfig(
+        messages_max_size_in_bytes=0
+    ).effective_max_message_bytes() is None
+    assert TcpCrossSiloMessageConfig(
+        messages_max_size_in_bytes=123
+    ).effective_max_message_bytes() == 123
+
+    addr = get_addresses(["bob"])
+    rp = TcpReceiverProxy(addr["bob"], "bob", "job", None, {})
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    host, port = addr["bob"].rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=10)
+    # Claim a 600MB payload: the receiver must drop the connection before
+    # buffering anything rather than np.empty(600MB) on attacker say-so.
+    s.sendall(wire.encode_prefix_and_header(
+        wire.FTYPE_DATA, {"job": "job"}, 600 * 1024 * 1024
+    ))
+    s.settimeout(10)
+    # Drop may surface as EOF or RST depending on unread socket state.
+    try:
+        assert s.recv(1) == b"", "receiver kept an over-cap connection open"
+    except ConnectionError:
+        pass
+    s.close()
+    rp.stop()
