@@ -64,11 +64,17 @@ class _WhitelistUnpickler(pickle.Unpickler):
 def restricted_loads(
     data: bytes, allowed_list: Optional[Dict[str, List[str]]]
 ) -> Any:
-    """Unpickle; if a whitelist is configured, only whitelisted globals load
-    (ref ``serialization_utils.py:66-83``)."""
-    if allowed_list is None:
+    """Unpickle; if a whitelist is configured, only whitelisted globals load.
+
+    Accepts the reference's config forms (``serialization_utils.py:66-83``):
+    a top-level ``"*"`` key disables the whitelist entirely, and a ``None``
+    (or ``["*"]``) value allows every name in that module.
+    """
+    if allowed_list is None or "*" in allowed_list:
         return cloudpickle.loads(data)
-    allowed = {m: set(ns) for m, ns in allowed_list.items()}
+    allowed = {
+        m: {"*"} if ns is None else set(ns) for m, ns in allowed_list.items()
+    }
     return _WhitelistUnpickler(io.BytesIO(data), allowed).load()
 
 
@@ -77,6 +83,104 @@ def restricted_loads(
 # ---------------------------------------------------------------------------
 
 _MSGPACK_SCALARS = (bool, int, float, str, bytes, type(None))
+
+
+class SegmentedPayload:
+    """A frame payload received as several buffers instead of one.
+
+    The receiver scatter-reads large ``tree`` payloads into leaf/shard-
+    aligned buffers (sized from the frame's meta) so no host buffer of the
+    whole payload — for a sharded array, of the global array — is ever
+    allocated. Consumers address it by the same absolute ``(offset, n)``
+    ranges the tree meta records.
+    """
+
+    def __init__(self, segments):
+        # segments: list of (absolute_offset, buffer), ascending, contiguous.
+        self._segments = [(off, memoryview(buf)) for off, buf in segments]
+        self._starts = [off for off, _ in self._segments]
+        self.nbytes = sum(v.nbytes for _, v in self._segments)
+
+    def range(self, off: int, n: int) -> memoryview:
+        import bisect
+
+        i = bisect.bisect_right(self._starts, off) - 1
+        if i >= 0:
+            seg_off, view = self._segments[i]
+            if off + n <= seg_off + view.nbytes:
+                return view[off - seg_off: off - seg_off + n]
+        raise ValueError(
+            f"range ({off}, {n}) does not fall inside one received segment"
+        )
+
+    def tobytes(self) -> bytes:
+        return b"".join(bytes(v) for _, v in self._segments)
+
+
+def payload_nbytes(payload) -> int:
+    if payload is None:
+        return 0
+    if isinstance(payload, SegmentedPayload):
+        return payload.nbytes
+    return memoryview(payload).nbytes
+
+
+def payload_range(payload, off: int, n: int) -> memoryview:
+    if isinstance(payload, SegmentedPayload):
+        return payload.range(off, n)
+    return memoryview(payload)[off: off + n]
+
+
+def payload_bytes(payload) -> bytes:
+    if isinstance(payload, SegmentedPayload):
+        return payload.tobytes()
+    return bytes(payload)
+
+
+# Extents below this are coalesced with their neighbors: scatter-reading
+# only pays off at shard scale, and thousands of tiny-leaf recv calls
+# would regress small-tree throughput.
+_MIN_SEGMENT = 256 * 1024
+
+
+def tree_segment_lengths(meta_bytes: bytes, plen: int):
+    """Buffer-aligned segment lengths for scatter-reading a ``tree``
+    payload, or None when the meta doesn't contiguously cover it.
+
+    Consecutive extents smaller than ``_MIN_SEGMENT`` are merged (a
+    leaf's range always stays inside one segment — merging only widens
+    segments), so a many-tiny-leaf tree still reads in big chunks while
+    shard-scale extents get their own buffers.
+    """
+    try:
+        meta = msgpack.unpackb(meta_bytes, raw=False)
+        extents = []
+        for d in meta["leaves"]:
+            if d["t"] == "arr":
+                extents.append((d["off"], d["n"]))
+            elif d["t"] == "sharr":
+                extents.extend((s["off"], s["n"]) for s in d["shards"])
+        extents.sort()
+        lengths = []
+        pos = 0
+        for off, n in extents:
+            if n < 0 or off != pos:
+                return None
+            if n:
+                if (
+                    lengths
+                    and lengths[-1] < _MIN_SEGMENT
+                    and n < _MIN_SEGMENT
+                ):
+                    lengths[-1] += n
+                else:
+                    lengths.append(n)
+                pos += n
+        if pos != plen:
+            return None
+        return lengths
+    except Exception:  # noqa: BLE001 - malformed meta -> single-buffer read
+        return None
 
 
 def _array_buffer(arr: np.ndarray):
@@ -112,6 +216,84 @@ def _np_dtype(name: str) -> np.dtype:
 def _is_array_leaf(x: Any) -> bool:
     # Covers numpy, jax.Array, torch.Tensor without importing any of them.
     return hasattr(x, "shape") and hasattr(x, "dtype") and hasattr(x, "__array__")
+
+
+def _normalize_index(index, shape):
+    """A shard's global slice as [[start, stop], ...] per dimension."""
+    out = []
+    for sl, dim in zip(index, shape):
+        if sl.step not in (None, 1):
+            return None
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _spec_entry_to_wire(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, str):
+        return entry
+    return [str(a) for a in entry]
+
+
+def try_encode_sharded(leaf, offset: int):
+    """Encode a multi-shard ``jax.Array`` as per-shard buffers (SURVEY §7
+    stage 4: sharded arrays cross the wire as shards, replacing the
+    device->host gather of the whole global array).
+
+    Returns (desc, buffers, nbytes) or None when the leaf is not a
+    partitioned, fully-addressable jax.Array on a named mesh (those fall
+    back to the dense ``arr`` path).
+    """
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or not hasattr(leaf, "addressable_shards"):
+        return None
+    try:
+        from jax.sharding import NamedSharding
+    except Exception:  # noqa: BLE001 - no jax in this process
+        return None
+    if not isinstance(sharding, NamedSharding):
+        return None
+    if not getattr(leaf, "is_fully_addressable", True):
+        return None
+    shape = tuple(int(d) for d in leaf.shape)
+    # One copy per distinct global slice (replica_id>0 hold the same data).
+    uniq = [s for s in leaf.addressable_shards if s.replica_id == 0]
+    if len(uniq) <= 1:
+        return None  # single shard / fully replicated: dense path is right
+    shard_entries = []
+    for s in uniq:
+        idx = _normalize_index(s.index, shape)
+        if idx is None:
+            return None
+        shard_entries.append((idx, s))
+    shard_entries.sort(key=lambda e: tuple(a for ab in e[0] for a in ab))
+    mesh = sharding.mesh
+    spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+    descs = []
+    buffers = []
+    total = 0
+    for idx, s in shard_entries:
+        arr = np.asarray(s.data)  # device->host of ONE shard only
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        buffers.append(_array_buffer(arr))
+        descs.append({"i": idx, "off": offset + total, "n": arr.nbytes})
+        total += arr.nbytes
+    desc = {
+        "t": "sharr",
+        "dtype": np.dtype(leaf.dtype).name,
+        "shape": list(shape),
+        "mesh": {
+            "axes": [str(a) for a in mesh.axis_names],
+            "shape": [int(d) for d in mesh.devices.shape],
+        },
+        "spec": [_spec_entry_to_wire(e) for e in spec],
+        "shards": descs,
+    }
+    return desc, buffers, total
 
 
 def _spec_to_wire(spec: tree_util.TreeSpec) -> Optional[dict]:
@@ -153,6 +335,13 @@ def try_encode_tree(data: Any) -> Optional[Tuple[dict, List[Any]]]:
     offset = 0
     for leaf in leaves:
         if _is_array_leaf(leaf):
+            sharded = try_encode_sharded(leaf, offset)
+            if sharded is not None:
+                desc, shard_bufs, total = sharded
+                descs.append(desc)
+                buffers.extend(shard_bufs)
+                offset += total
+                continue
             arr = np.asarray(leaf)  # device->host for jax arrays
             if arr.dtype == object:
                 return None
@@ -184,20 +373,83 @@ def try_encode_tree(data: Any) -> Optional[Tuple[dict, List[Any]]]:
     return meta, buffers
 
 
-def decode_tree(meta: dict, payload) -> Any:
-    """Inverse of :func:`try_encode_tree`. ``payload`` is a bytes-like of the
-    concatenated buffers; array leaves are materialized as numpy views
-    (zero-copy) — the TPU transport then ``jax.device_put``s them onto the
-    party mesh."""
-    view = memoryview(payload)
+def shard_view(desc: dict, shard: dict, payload) -> np.ndarray:
+    """A zero-copy numpy view of one received shard's data."""
+    dtype = _np_dtype(desc["dtype"])
+    shape = [b - a for a, b in shard["i"]]
+    raw = payload_range(payload, shard["off"], shard["n"])
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+
+def region_volume(region) -> int:
+    v = 1
+    for a, b in region:
+        v *= max(0, b - a)
+    return v
+
+
+def regions_cover_exactly(regions, target) -> bool:
+    """True iff ``regions`` (clipped to ``target``) tile ``target`` exactly:
+    full coverage, zero overlap. Guards against hostile/buggy shard metas
+    whose byte counts add up while leaving holes (which would surface
+    uninitialized receiver memory as array contents)."""
+    clipped = []
+    for r in regions:
+        c = [
+            [max(a, ta), min(b, tb)]
+            for (a, b), (ta, tb) in zip(r, target)
+        ]
+        if region_volume(c) > 0:
+            clipped.append(c)
+    if sum(region_volume(c) for c in clipped) != region_volume(target):
+        return False
+    for i in range(len(clipped)):
+        for j in range(i + 1, len(clipped)):
+            inter = [
+                [max(a1, a2), min(b1, b2)]
+                for (a1, b1), (a2, b2) in zip(clipped[i], clipped[j])
+            ]
+            if region_volume(inter) > 0:
+                return False
+    return True
+
+
+def assemble_global(desc: dict, payload) -> np.ndarray:
+    """Reassemble a ``sharr`` leaf into one dense host array (fallback for
+    receivers without a device mesh; the TPU lane reassembles per-device
+    instead, see ``proxy/tpu/tpu_proxy.py``)."""
+    dtype = _np_dtype(desc["dtype"])
+    target = [[0, int(d)] for d in desc["shape"]]
+    if not regions_cover_exactly([s["i"] for s in desc["shards"]], target):
+        raise ValueError(
+            "sharded leaf's shards do not exactly tile the global array"
+        )
+    out = np.empty(desc["shape"], dtype)
+    for shard in desc["shards"]:
+        region = tuple(slice(a, b) for a, b in shard["i"])
+        out[region] = shard_view(desc, shard, payload)
+    return out
+
+
+def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:
+    """Inverse of :func:`try_encode_tree`. ``payload`` is a bytes-like (or
+    :class:`SegmentedPayload`) of the concatenated buffers; array leaves are
+    materialized as numpy views (zero-copy). ``sharded_fn(desc, payload)``
+    lets a transport place ``sharr`` leaves directly onto devices; without
+    it they are assembled into dense host arrays."""
     spec = _spec_from_wire(meta["spec"])
     leaves = []
     for d in meta["leaves"]:
         if d["t"] == "arr":
             dtype = _np_dtype(d["dtype"])
-            raw = view[d["off"]: d["off"] + d["n"]]
+            raw = payload_range(payload, d["off"], d["n"])
             arr = np.frombuffer(raw, dtype=dtype).reshape(d["shape"])
             leaves.append(arr)
+        elif d["t"] == "sharr":
+            if sharded_fn is not None:
+                leaves.append(sharded_fn(d, payload))
+            else:
+                leaves.append(assemble_global(d, payload))
         else:
             leaves.append(d["v"])
     return tree_util.tree_unflatten(leaves, spec)
@@ -222,9 +474,12 @@ def decode_payload(
     meta_bytes: bytes,
     payload,
     allowed_list: Optional[Dict[str, List[str]]] = None,
+    sharded_fn=None,
 ) -> Any:
     if kind == "tree":
-        return decode_tree(msgpack.unpackb(meta_bytes, raw=False), payload)
+        return decode_tree(
+            msgpack.unpackb(meta_bytes, raw=False), payload, sharded_fn
+        )
     if kind == "pickle":
-        return restricted_loads(bytes(payload), allowed_list)
+        return restricted_loads(payload_bytes(payload), allowed_list)
     raise ValueError(f"unknown payload kind: {kind}")

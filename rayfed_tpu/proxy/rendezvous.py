@@ -32,7 +32,7 @@ logger = logging.getLogger(__name__)
 DecodeFn = Callable[[Dict, memoryview], object]
 
 
-def default_decode(allowed_list, allow_pickle: bool = True):
+def default_decode(allowed_list, allow_pickle: bool = True, sharded_fn=None):
     def decode(header: Dict, payload) -> object:
         effective = allowed_list
         if not allow_pickle and header.get("pkind") == "pickle":
@@ -43,7 +43,8 @@ def default_decode(allowed_list, allow_pickle: bool = True):
             # only), never the unrestricted loader.
             effective = {}
         return serialization.decode_payload(
-            header["pkind"], header.get("pmeta", b""), payload, effective
+            header["pkind"], header.get("pmeta", b""), payload, effective,
+            sharded_fn=sharded_fn,
         )
 
     return decode
@@ -135,7 +136,7 @@ class RendezvousStore:
                 CODE_JOB_MISMATCH,
                 f"job name mismatch: got {job!r}, expected {self._job_name!r}",
             )
-        nbytes = memoryview(payload).nbytes if payload is not None else 0
+        nbytes = serialization.payload_nbytes(payload)
         if self._max_payload_bytes is not None and nbytes > self._max_payload_bytes:
             return (
                 CODE_INTERNAL_ERROR,
@@ -173,7 +174,7 @@ class RendezvousStore:
 
             tracing.record(
                 "recv", header.get("src", ""), header["up"], header["down"],
-                memoryview(payload).nbytes if payload is not None else 0,
+                serialization.payload_nbytes(payload),
                 time.perf_counter(),
             )
         if waiter is not None:
@@ -212,7 +213,7 @@ class RendezvousStore:
             with tracing.span(
                 "decode", header.get("src", ""), header["up"],
                 header["down"],
-                memoryview(payload).nbytes if payload is not None else 0,
+                serialization.payload_nbytes(payload),
             ):
                 value = self._decode_fn(header, payload)
         except BaseException as e:  # noqa: BLE001 - surfaced to consumer

@@ -85,14 +85,20 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
     return buf
 
 
+# Tree payloads at least this large are scatter-read into per-buffer
+# segments (so a sharded array never lands in one global-size host buffer).
+_SEGMENT_THRESHOLD = 1 << 20
+
+
 def recv_frame(
     sock: socket.socket,
     max_payload: Optional[int] = None,
-) -> Tuple[int, Dict, memoryview]:
+):
     """Blocking read of one frame. Size caps are enforced before the
     payload is buffered, so an oversized frame costs no memory — the
     connection is torn down instead of answered. Payload is a writable
-    numpy-backed view."""
+    numpy-backed view, or a :class:`serialization.SegmentedPayload` when a
+    large ``tree`` frame is scatter-read into leaf/shard-aligned buffers."""
     prefix = _recv_exact(sock, wire.PREFIX_LEN)
     magic, version, ftype, hlen, plen = wire._PREFIX.unpack(bytes(prefix))
     if magic != wire.WIRE_MAGIC:
@@ -113,6 +119,22 @@ def recv_frame(
     # pure waste since recv_into overwrites every byte) and halves page
     # traffic on fresh buffers; the returned view stays writable.
     import numpy as np
+
+    from rayfed_tpu._private import serialization
+
+    if plen >= _SEGMENT_THRESHOLD and header.get("pkind") == "tree":
+        lengths = serialization.tree_segment_lengths(
+            header.get("pmeta", b""), plen
+        )
+        if lengths is not None and len(lengths) > 1:
+            segments = []
+            pos = 0
+            for n in lengths:
+                buf = np.empty(n, dtype=np.uint8)
+                _recv_exact_into(sock, memoryview(buf))
+                segments.append((pos, buf))
+                pos += n
+            return ftype, header, serialization.SegmentedPayload(segments)
 
     payload = np.empty(plen, dtype=np.uint8)
     _recv_exact_into(sock, memoryview(payload))

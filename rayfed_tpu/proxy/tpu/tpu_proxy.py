@@ -31,7 +31,9 @@ class TpuSenderProxy(TcpSenderProxy):
 
 
 def _device_placer(allowed_list, allow_pickle: bool = True):
-    base = rendezvous.default_decode(allowed_list, allow_pickle=allow_pickle)
+    base = rendezvous.default_decode(
+        allowed_list, allow_pickle=allow_pickle, sharded_fn=place_sharded
+    )
 
     def decode(header, payload):
         value = base(header, payload)
@@ -66,6 +68,111 @@ def _place_tree(value, mesh):
         return leaf
 
     return jax.tree_util.tree_map(place, value)
+
+
+def _mirror_sharding(mesh, desc):
+    """The sender's PartitionSpec re-expressed on this party's mesh, or
+    None when the mesh cannot host it (missing axes / non-dividing dims)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    entries = []
+    for dim, e in zip(desc["shape"], desc["spec"]):
+        names = [] if e is None else ([e] if isinstance(e, str) else list(e))
+        if not all(n in sizes for n in names):
+            return None
+        k = 1
+        for n in names:
+            k *= sizes[n]
+        if k > 1 and dim % k != 0:
+            return None
+        if not names:
+            entries.append(None)
+        elif len(names) == 1:
+            entries.append(names[0])
+        else:
+            entries.append(tuple(names))
+    while entries and entries[-1] is None:
+        entries.pop()  # PartitionSpec('x', None) != PartitionSpec('x')
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
+def _extract_region(desc, payload, region):
+    """Host array for one device's required slice of the global array:
+    a zero-copy view when the region matches a received shard exactly,
+    otherwise assembled from the overlapping shards."""
+    import numpy as np
+
+    from rayfed_tpu._private.serialization import (
+        _np_dtype,
+        regions_cover_exactly,
+        shard_view,
+    )
+
+    for shard in desc["shards"]:
+        if shard["i"] == region:
+            return shard_view(desc, shard, payload)
+    if not regions_cover_exactly([s["i"] for s in desc["shards"]], region):
+        raise ValueError(
+            f"received shards do not exactly tile requested region {region}"
+        )
+    shape = [b - a for a, b in region]
+    out = np.empty(shape, _np_dtype(desc["dtype"]))
+    for shard in desc["shards"]:
+        inter = [
+            [max(sa, ra), min(sb, rb)]
+            for (sa, sb), (ra, rb) in zip(shard["i"], region)
+        ]
+        if any(a >= b for a, b in inter):
+            continue
+        src = shard_view(desc, shard, payload)
+        src_sl = tuple(
+            slice(a - sa, b - sa)
+            for (a, b), (sa, _) in zip(inter, shard["i"])
+        )
+        dst_sl = tuple(
+            slice(a - ra, b - ra)
+            for (a, b), (ra, _) in zip(inter, region)
+        )
+        out[dst_sl] = src[src_sl]
+    return out
+
+
+def place_sharded(desc, payload):
+    """Reassemble a ``sharr`` wire leaf directly onto the party mesh.
+
+    Per-device slices are staged host-side individually and joined with
+    ``jax.make_array_from_single_device_arrays`` — no host buffer of the
+    global array is materialized when the local mesh mirrors the sender's
+    partitioning (SURVEY §7 stage 4 north star).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from rayfed_tpu._private.serialization import assemble_global
+
+    mesh = _party_mesh()
+    if mesh is None:
+        return assemble_global(desc, payload)
+    shape = tuple(desc["shape"])
+    target = _mirror_sharding(mesh, desc)
+    if target is None:
+        # Mesh can't express the sender's layout: replicate (dense path).
+        return jax.device_put(
+            assemble_global(desc, payload),
+            NamedSharding(mesh, PartitionSpec()),
+        )
+    idx_map = target.addressable_devices_indices_map(shape)
+    arrays = []
+    for device, index in idx_map.items():
+        region = [
+            [0 if sl.start is None else int(sl.start),
+             dim if sl.stop is None else int(sl.stop)]
+            for sl, dim in zip(index, shape)
+        ]
+        slab = _extract_region(desc, payload, region)
+        arrays.append(jax.device_put(slab, device))
+    return jax.make_array_from_single_device_arrays(shape, target, arrays)
 
 
 class TpuReceiverProxy(TcpReceiverProxy):

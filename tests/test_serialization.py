@@ -105,6 +105,20 @@ def test_whitelist_wildcard():
     assert out == Custom(5)
 
 
+def test_whitelist_none_value_allows_whole_module():
+    # Reference form (serialization_utils.py:66-83): {module: None} admits
+    # every name in that module.
+    blob = ser.dumps(Custom(5))
+    out = ser.restricted_loads(blob, {__name__: None})
+    assert out == Custom(5)
+
+
+def test_whitelist_top_level_star_disables_whitelist():
+    blob = ser.dumps(Custom(5))
+    out = ser.restricted_loads(blob, {"*": None})
+    assert out == Custom(5)
+
+
 def test_fed_remote_error_always_unpicklable():
     from rayfed_tpu.exceptions import FedRemoteError
 
