@@ -156,8 +156,40 @@ def _try_build_fastwire() -> None:
         pass
 
 
+def _try_train_mfu():
+    """Flagship train-step MFU on the local accelerator (TPU only) —
+    recorded alongside the push-throughput headline. Best-effort: the
+    transport benchmark stands on its own if this fails."""
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return None
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from contextlib import redirect_stdout
+
+        from transformer_train_benchmark import run as train_run
+
+        # The train bench prints a human-readable line; keep stdout clean
+        # for the driver's single JSON line.
+        with redirect_stdout(sys.stderr):
+            r = train_run(2048, 12, 2048, batch=12, steps=10, vocab=32768)
+        return {
+            "train_tokens_per_s": round(r["tokens_per_s"]),
+            "train_mfu": round(r["mfu"], 4),
+            "train_n_params": r["n_params"],
+            "train_seq": r["seq"],
+        }
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"train MFU bench skipped: {e!r}", file=sys.stderr)
+        return None
+
+
 def main() -> None:
     _try_build_fastwire()
+    mfu = _try_train_mfu()
     native = run_transport("tcp")
     baseline = run_transport("grpc")
     result = {
@@ -169,6 +201,8 @@ def main() -> None:
         "rounds": ROUNDS,
         "payload_mb": PAYLOAD_MB,
     }
+    if mfu:
+        result.update(mfu)
     print(json.dumps(result))
 
 

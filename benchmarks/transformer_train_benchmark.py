@@ -1,11 +1,18 @@
-"""Flagship-model training throughput on the local accelerator.
+"""Flagship-model training throughput + MFU on the local accelerator.
 
-Measures tokens/second for the transformer LM train step (bf16 compute,
-f32 params/optimizer) at a configurable size — the party-local compute
+Measures tokens/second and model-FLOPs utilization for the transformer LM
+train step (bf16 compute, f32 params/optimizer) — the party-local compute
 half of federated training, complementing the cross-party transport
-benchmarks.
+benchmarks. On TPU the step uses the Pallas flash-attention kernel and
+per-layer rematerialization by default.
+
+Model FLOPs per token = 6*N + 12*L*d_model*S*0.5 (causal attention),
+the standard accounting (PaLM appendix B convention). Peak chip FLOPs for
+the MFU denominator comes from PEAK_TFLOPS (default 197, TPU v5e bf16).
 
 Usage: python benchmarks/transformer_train_benchmark.py [d_model] [layers] [seq]
+Env: REMAT=0/1 (default 1 on TPU), ATTN=auto|flash|xla, BATCH, STEPS,
+PEAK_TFLOPS.
 """
 
 from __future__ import annotations
@@ -17,7 +24,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=False):
+def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
+        attn="auto", peak_tflops=197.0, vocab=8192):
     import jax
     import numpy as np
     from jax.sharding import Mesh, NamedSharding
@@ -26,14 +34,21 @@ def main(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=False):
     from rayfed_tpu.parallel import sharding as shd
     from rayfed_tpu.parallel.train import make_fed_train_step
 
+    on_tpu = jax.default_backend() == "tpu"
+    if remat is None:
+        remat = on_tpu  # memory-for-FLOPs is the right default on the chip
+
+    # head_dim 128 fills the TPU's 128-lane tiling exactly — head_dim 64
+    # arrays get lane-padded 2x in HBM (memory AND bandwidth waste).
     cfg = tfm.TransformerConfig(
-        vocab=8192, d_model=d_model, n_heads=max(4, d_model // 64),
+        vocab=vocab, d_model=d_model, n_heads=max(2, d_model // 128),
         n_layers=n_layers, d_ff=int(d_model * 2.75) // 16 * 16,
     )
     devices = jax.devices()
     mesh = Mesh(np.array(devices).reshape(len(devices)), ("data",))
     init_fn, step_fn = make_fed_train_step(
-        cfg, mesh, party_axis=None, data_axis="data", lr=1e-3, remat=remat
+        cfg, mesh, party_axis=None, data_axis="data", lr=1e-3, remat=remat,
+        attn=attn,
     )
     tokens = jax.random.randint(
         jax.random.PRNGKey(0), (batch, seq + 1), 0, cfg.vocab
@@ -44,6 +59,9 @@ def main(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=False):
     params, opt_state = init_fn(jax.random.PRNGKey(0), inputs)
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # PaLM appendix-B convention: the embedding table is a gather, not a
+    # matmul — excluded from the 6N FLOPs term (lm_head stays in).
+    n_matmul_params = n_params - params["embed"].size
     # Warmup/compile.
     params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
     float(loss)
@@ -53,13 +71,45 @@ def main(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=False):
     loss = float(loss)
     dt = time.perf_counter() - t0
     tok_s = steps * batch * seq / dt
+    # 6N covers fwd+bwd matmuls on the params; the attention term is
+    # 12*L*d*S per token halved for causality.
+    flops_per_token = 6 * n_matmul_params + 12 * n_layers * d_model * seq * 0.5
+    mfu = tok_s * flops_per_token / (peak_tflops * 1e12 * len(devices))
+    result = {
+        "backend": jax.default_backend(),
+        "devices": len(devices),
+        "n_params": n_params,
+        "batch": batch,
+        "seq": seq,
+        "remat": bool(remat),
+        "attn": attn,
+        "tokens_per_s": tok_s,
+        "ms_per_step": dt / steps * 1000,
+        "mfu": mfu,
+        "peak_tflops": peak_tflops,
+        "loss": loss,
+    }
     print(
-        f"{jax.default_backend()} x{len(devices)}: {n_params/1e6:.1f}M params, "
-        f"batch {batch} x seq {seq}: {tok_s:,.0f} tokens/s "
-        f"({dt/steps*1000:.1f} ms/step), loss {loss:.3f}"
+        f"{result['backend']} x{result['devices']}: {n_params/1e6:.1f}M params, "
+        f"batch {batch} x seq {seq} (attn={attn}, remat={remat}): "
+        f"{tok_s:,.0f} tokens/s ({result['ms_per_step']:.1f} ms/step), "
+        f"MFU {mfu*100:.1f}% (peak {peak_tflops} TF/chip), loss {loss:.3f}"
+    )
+    return result
+
+
+def main():
+    args = [int(a) for a in sys.argv[1:4]]
+    remat_env = os.environ.get("REMAT")
+    run(
+        *args,
+        batch=int(os.environ.get("BATCH", 8)),
+        steps=int(os.environ.get("STEPS", 20)),
+        remat=None if remat_env is None else remat_env == "1",
+        attn=os.environ.get("ATTN", "auto"),
+        peak_tflops=float(os.environ.get("PEAK_TFLOPS", 197.0)),
     )
 
 
 if __name__ == "__main__":
-    args = [int(a) for a in sys.argv[1:4]]
-    main(*args, remat=os.environ.get("REMAT", "0") == "1")
+    main()

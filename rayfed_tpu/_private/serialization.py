@@ -414,21 +414,49 @@ def regions_cover_exactly(regions, target) -> bool:
     return True
 
 
+def extract_region(desc: dict, payload, region) -> np.ndarray:
+    """Host array for one requested slice of a ``sharr`` leaf's global
+    array: a zero-copy view when the region matches a received shard
+    exactly, otherwise assembled from the overlapping shards. ``region``
+    is [[start, stop], ...] per dimension. The received shards must
+    exactly tile the requested region (no holes, no double-writes) — the
+    guard against hostile/buggy metas surfacing uninitialized memory."""
+    for shard in desc["shards"]:
+        if shard["i"] == region:
+            return shard_view(desc, shard, payload)
+    if not regions_cover_exactly([s["i"] for s in desc["shards"]], region):
+        raise ValueError(
+            f"received shards do not exactly tile requested region {region}"
+        )
+    shape = [b - a for a, b in region]
+    out = np.empty(shape, _np_dtype(desc["dtype"]))
+    for shard in desc["shards"]:
+        inter = [
+            [max(sa, ra), min(sb, rb)]
+            for (sa, sb), (ra, rb) in zip(shard["i"], region)
+        ]
+        if any(a >= b for a, b in inter):
+            continue
+        src = shard_view(desc, shard, payload)
+        src_sl = tuple(
+            slice(a - sa, b - sa)
+            for (a, b), (sa, _) in zip(inter, shard["i"])
+        )
+        dst_sl = tuple(
+            slice(a - ra, b - ra)
+            for (a, b), (ra, _) in zip(inter, region)
+        )
+        out[dst_sl] = src[src_sl]
+    return out
+
+
 def assemble_global(desc: dict, payload) -> np.ndarray:
     """Reassemble a ``sharr`` leaf into one dense host array (fallback for
     receivers without a device mesh; the TPU lane reassembles per-device
     instead, see ``proxy/tpu/tpu_proxy.py``)."""
-    dtype = _np_dtype(desc["dtype"])
-    target = [[0, int(d)] for d in desc["shape"]]
-    if not regions_cover_exactly([s["i"] for s in desc["shards"]], target):
-        raise ValueError(
-            "sharded leaf's shards do not exactly tile the global array"
-        )
-    out = np.empty(desc["shape"], dtype)
-    for shard in desc["shards"]:
-        region = tuple(slice(a, b) for a, b in shard["i"])
-        out[region] = shard_view(desc, shard, payload)
-    return out
+    return extract_region(
+        desc, payload, [[0, int(d)] for d in desc["shape"]]
+    )
 
 
 def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 Params = Dict[str, Any]
 
@@ -171,7 +172,10 @@ def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cdt))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cdt))
     q, k = rope(q, k, positions, cfg.rope_theta)
-    o = attn_fn(q, k, v)
+    # Named for selective rematerialization: saving each layer's attention
+    # output (B*S*D, the cheapest-to-keep/most-expensive-to-recompute
+    # tensor) lets the remat backward skip re-running the attention kernel.
+    o = checkpoint_name(attn_fn(q, k, v), "attn_out")
     x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
     hmlp = rms_norm(x, layer["ln2"])
     if cfg.n_experts > 0:
@@ -188,10 +192,11 @@ def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
     return x
 
 
-def forward(params: Params, tokens, cfg: TransformerConfig,
-            attn_fn: Optional[AttnFn] = None,
-            positions=None, remat: bool = False) -> jax.Array:
-    """tokens (B, S) int32 -> logits (B, S, vocab) float32.
+def hidden_states(params: Params, tokens, cfg: TransformerConfig,
+                  attn_fn: Optional[AttnFn] = None,
+                  positions=None, remat: bool = False) -> jax.Array:
+    """tokens (B, S) int32 -> final hidden states (B, S, d_model), post
+    final-norm, in compute dtype.
 
     Layers run under one ``lax.scan`` over the stacked parameters.
     ``remat=True`` checkpoints each layer (recompute activations in the
@@ -209,23 +214,66 @@ def forward(params: Params, tokens, cfg: TransformerConfig,
     if remat:
         # prevent_cse=False: scan's loop semantics already block the CSE
         # that checkpoint's default barriers guard against; leaving them on
-        # just costs XLA fusion opportunities.
-        body = jax.checkpoint(body, prevent_cse=False)
+        # just costs XLA fusion opportunities. remat="attn" additionally
+        # saves each layer's attention output (B*S*d_model bf16) so the
+        # backward skips re-running the attention kernel — opt-in: the
+        # named-save policy costs dramatically longer XLA compiles around
+        # the Pallas custom_vjp under scan.
+        policy = (
+            jax.checkpoint_policies.save_only_these_names("attn_out")
+            if remat == "attn" else None
+        )
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["ln_f"])
+    return rms_norm(x, params["ln_f"])
+
+
+def forward(params: Params, tokens, cfg: TransformerConfig,
+            attn_fn: Optional[AttnFn] = None,
+            positions=None, remat: bool = False) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) float32."""
+    x = hidden_states(params, tokens, cfg, attn_fn, positions, remat)
     return (x @ params["lm_head"].astype(cfg.compute_dtype)).astype(jnp.float32)
 
 
 def lm_loss_pair(params: Params, inputs, targets, cfg: TransformerConfig,
                  attn_fn: Optional[AttnFn] = None,
-                 remat: bool = False) -> jax.Array:
+                 remat: bool = False,
+                 loss_chunk: Optional[int] = None) -> jax.Array:
     """Next-token cross entropy over pre-shifted (inputs, targets) pairs,
     both (B, S) — the sharding-friendly form (S stays divisible by the seq
-    axis; no in-jit slicing of sharded dims). f32 accumulation."""
-    logits = forward(params, inputs, cfg, attn_fn, remat=remat)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return (logz - gold).mean()
+    axis; no in-jit slicing of sharded dims). f32 accumulation.
+
+    ``loss_chunk`` evaluates the vocab head + CE in checkpointed chunks of
+    that many sequence positions, so the full (B, S, vocab) f32 logits
+    never materialize — at 32k vocab they dominate step memory. Leave None
+    when the sequence dim is sharded (chunking reshapes S).
+    """
+    x = hidden_states(params, inputs, cfg, attn_fn, remat=remat)
+    w = params["lm_head"].astype(cfg.compute_dtype)
+    if not loss_chunk or x.shape[1] % loss_chunk:
+        logits = (x @ w).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return (logz - gold).mean()
+
+    b, s, d = x.shape
+    n = s // loss_chunk
+
+    def chunk_ce(carry, xt):
+        xc, tc = xt  # (B, chunk, D), (B, chunk)
+        logits = (xc @ w).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return carry + (logz - gold).sum(), None
+
+    xs = jnp.moveaxis(x.reshape(b, n, loss_chunk, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n, loss_chunk), 1, 0)
+    total, _ = jax.lax.scan(
+        jax.checkpoint(chunk_ce, prevent_cse=False), jnp.zeros((), jnp.float32),
+        (xs, ts),
+    )
+    return total / (b * s)
 
 
 def lm_loss(params: Params, tokens, cfg: TransformerConfig,

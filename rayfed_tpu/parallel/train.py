@@ -42,6 +42,7 @@ def make_fed_train_step(
     seq_axis: Optional[str] = None,
     lr: float = 3e-4,
     remat: bool = False,
+    attn: str = "auto",
 ):
     """Build (init_fn, step_fn) jitted over ``mesh``.
 
@@ -49,14 +50,33 @@ def make_fed_train_step(
     according to the partition rules; ``step_fn(params, opt_state, inputs,
     targets) -> (params, opt_state, loss)`` is one synchronized federated
     step over pre-shifted (B, S) input/target blocks.
+
+    ``attn`` selects the on-device attention: ``"flash"`` = the Pallas
+    flash kernel (O(S) memory, differentiable), ``"xla"`` = the dense
+    reference attention, ``"auto"`` (default) = flash on TPU backends,
+    dense elsewhere (the kernel's interpret mode is test-speed only).
+    When the ``seq`` axis is sharded, ring attention takes precedence and
+    ``attn`` is ignored (its per-block attention is the dense kernel).
     """
     optimizer = make_optimizer(lr)
     use_ring = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
+    if attn not in ("auto", "flash", "xla"):
+        raise ValueError(f"attn must be 'auto', 'flash', or 'xla'; got {attn!r}")
+    requested_flash = attn == "flash"
+    if attn == "auto":
+        attn = "flash" if jax.default_backend() == "tpu" else "xla"
+    if use_ring and requested_flash:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "attn='flash' is ignored: the sharded %r axis routes attention "
+            "through the ring lane (dense per-shard blocks).", seq_axis
+        )
 
     if use_ring:
         # Sequence-parallel attention: shard_map over the seq axis with K/V
         # ring rotation; every other axis stays GSPMD-automatic.
-        def attn(q, k, v):
+        def ring_attn(q, k, v):
             pspec = P(None, seq_axis, None, None)
             return shard_map(
                 functools.partial(ring_attention, axis_name=seq_axis),
@@ -67,16 +87,24 @@ def make_fed_train_step(
                 axis_names={seq_axis},
             )(q, k, v)
 
-        attn_fn = attn
+        attn_fn = ring_attn
+    elif attn == "flash":
+        from rayfed_tpu.ops.flash_attention import make_flash_attn_fn
+
+        attn_fn = make_flash_attn_fn()
     else:
         attn_fn = None
 
     batch_pspec = shd.batch_spec(mesh, party_axis, data_axis, seq_axis)
     batch_sharding = NamedSharding(mesh, batch_pspec)
+    # Chunked head+CE keeps (B, S, vocab) f32 logits out of HBM; disabled
+    # when S is sharded (chunking reshapes the sequence dim).
+    loss_chunk = None if use_ring else 512
 
     def loss_fn(params, inputs, targets):
         return tfm.lm_loss_pair(
-            params, inputs, targets, cfg, attn_fn, remat=remat
+            params, inputs, targets, cfg, attn_fn, remat=remat,
+            loss_chunk=loss_chunk,
         )
 
     def step(params, opt_state, inputs, targets):

@@ -98,44 +98,9 @@ def _mirror_sharding(mesh, desc):
 
 
 def _extract_region(desc, payload, region):
-    """Host array for one device's required slice of the global array:
-    a zero-copy view when the region matches a received shard exactly,
-    otherwise assembled from the overlapping shards."""
-    import numpy as np
+    from rayfed_tpu._private.serialization import extract_region
 
-    from rayfed_tpu._private.serialization import (
-        _np_dtype,
-        regions_cover_exactly,
-        shard_view,
-    )
-
-    for shard in desc["shards"]:
-        if shard["i"] == region:
-            return shard_view(desc, shard, payload)
-    if not regions_cover_exactly([s["i"] for s in desc["shards"]], region):
-        raise ValueError(
-            f"received shards do not exactly tile requested region {region}"
-        )
-    shape = [b - a for a, b in region]
-    out = np.empty(shape, _np_dtype(desc["dtype"]))
-    for shard in desc["shards"]:
-        inter = [
-            [max(sa, ra), min(sb, rb)]
-            for (sa, sb), (ra, rb) in zip(shard["i"], region)
-        ]
-        if any(a >= b for a, b in inter):
-            continue
-        src = shard_view(desc, shard, payload)
-        src_sl = tuple(
-            slice(a - sa, b - sa)
-            for (a, b), (sa, _) in zip(inter, shard["i"])
-        )
-        dst_sl = tuple(
-            slice(a - ra, b - ra)
-            for (a, b), (ra, _) in zip(inter, region)
-        )
-        out[dst_sl] = src[src_sl]
-    return out
+    return extract_region(desc, payload, region)
 
 
 def place_sharded(desc, payload):
