@@ -109,14 +109,20 @@ def make_ep_moe_apply(mesh: Mesh, expert_axis: str = "expert"):
 
 
 def make_a2a_moe_apply(mesh: Mesh, expert_axis: str = "expert",
-                       capacity_factor: float = 1.25):
+                       capacity_factor: float = 1.25, k: int = 1):
     """Capacity-based all-to-all expert dispatch (switch-style) — the
     scalable EP form: tokens are sharded over the expert axis, each device
-    selects up to C tokens per expert, one ``all_to_all`` routes them to
-    their expert's device, the FFN runs on E_local experts, and a second
-    ``all_to_all`` routes results home. Compute per device is
-    O(E_local * C) instead of the dense path's O(E * N); tokens over an
-    expert's capacity are dropped (output zero), the standard trade.
+    selects up to C (token, choice) assignments per expert, one
+    ``all_to_all`` routes them to their expert's device, the FFN runs on
+    E_local experts, and a second ``all_to_all`` routes results home.
+    Compute per device is O(E_local * C) instead of the dense path's
+    O(E * N); assignments over an expert's capacity are dropped (that
+    choice contributes zero), the standard trade.
+
+    ``k`` = experts per token: 1 reproduces switch-style top-1 routing
+    (``_gates``), k>1 routes each token to its top-k experts with
+    renormalized gates (``topk_gates``) — capacity scales with k so the
+    expected slot load is unchanged.
 
     Call with token-sharded x of shape (N, d) — N divisible by the axis
     size — and full-size expert params; returns (N, d).
@@ -127,16 +133,19 @@ def make_a2a_moe_apply(mesh: Mesh, expert_axis: str = "expert",
         n_local, d = x.shape
         e_local = params["w_up"].shape[0]
         n_experts = e_local * n_dev
-        capacity = max(1, int(n_local * capacity_factor / n_experts))
+        capacity = max(1, int(k * n_local * capacity_factor / n_experts))
 
-        gates = _gates(params, x, top1=True)          # (N_local, E) one-hot-ish
+        if k == 1:
+            gates = _gates(params, x, top1=True)      # (N_local, E)
+        else:
+            gates = topk_gates(params, x, k)          # (N_local, E), k>0/row
         # Ranks MUST accumulate in int32: a low-precision cumsum (bf16 has
         # an 8-bit mantissa) silently collides tokens onto the same slot
         # once ranks exceed the dtype's exact-integer range.
         onehot_i = (gates > 0).astype(jnp.int32)       # (N_local, E)
-        gate_val = gates.sum(axis=-1)                  # (N_local,)
 
-        # Rank of each token within its expert's queue; drop overflow.
+        # Rank of each (token, choice) within its expert's queue; drop
+        # overflow.
         pos = jnp.cumsum(onehot_i, axis=0) * onehot_i  # 1-based ranks
         keep = (pos > 0) & (pos <= capacity)
         loc = jnp.clip(pos - 1, 0, capacity - 1)
@@ -169,8 +178,10 @@ def make_a2a_moe_apply(mesh: Mesh, expert_axis: str = "expert",
             back, expert_axis, split_axis=0, concat_axis=0, tiled=False
         ).reshape(n_experts, capacity, d)
 
-        combined = jnp.einsum("nec,ecd->nd", dispatch, home)
-        return combined * gate_val[:, None]
+        # Combine weights = gate * dispatch: each surviving (token, choice)
+        # contributes its expert's output scaled by its gate.
+        combine = dispatch * gates[..., None]
+        return jnp.einsum("nec,ecd->nd", combine, home)
 
     e_spec = {"router": P(), "w_up": P(expert_axis), "w_down": P(expert_axis)}
     return shard_map(

@@ -270,3 +270,63 @@ def test_topk_gates_and_loss():
     # Differentiable.
     grad = jax.grad(lambda p: load_balance_loss(p, x))(params)
     assert bool(jnp.isfinite(grad["router"]).all())
+
+
+def test_a2a_moe_topk_matches_dense_topk():
+    """k=2 all-to-all dispatch equals the dense top-k lane when capacity
+    is ample (VERDICT r1 #7)."""
+    from rayfed_tpu.models.moe import make_a2a_moe_apply, moe_ffn_apply_topk
+
+    d, f, e = 16, 32, 8
+    params = init_moe_ffn(jax.random.PRNGKey(6), d, f, e)
+    n = 64
+    x = jax.random.normal(jax.random.PRNGKey(7), (n, d))
+    dense = moe_ffn_apply_topk(params, x, k=2)
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("expert",))
+    got = jax.jit(make_a2a_moe_apply(mesh, capacity_factor=8.0, k=2))(
+        params, x
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_a2a_moe_topk_drops_only_overflowed_choices():
+    """Under tight capacity a token keeps the contribution of choices that
+    fit — k=2 degrades gracefully instead of zeroing whole tokens."""
+    from rayfed_tpu.models.moe import make_a2a_moe_apply
+
+    d, f, e = 8, 16, 8
+    params = init_moe_ffn(jax.random.PRNGKey(8), d, f, e)
+    n = 64
+    x = jax.random.normal(jax.random.PRNGKey(9), (n, d))
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("expert",))
+    tight = np.asarray(
+        jax.jit(make_a2a_moe_apply(mesh, capacity_factor=0.5, k=2))(params, x)
+    )
+    ample = np.asarray(
+        jax.jit(make_a2a_moe_apply(mesh, capacity_factor=8.0, k=2))(params, x)
+    )
+    # Some choices overflowed (outputs differ), but full-token zeros should
+    # be rarer than in top-1: a token is zero only if BOTH choices dropped.
+    assert not np.allclose(tight, ample)
+    changed = ~np.isclose(tight, ample, rtol=2e-5, atol=2e-5).all(axis=-1)
+    assert changed.any()
+
+
+def test_a2a_moe_topk_gradients_flow():
+    from rayfed_tpu.models.moe import make_a2a_moe_apply
+
+    d, f, e = 8, 16, 8
+    params = init_moe_ffn(jax.random.PRNGKey(10), d, f, e)
+    x = jax.random.normal(jax.random.PRNGKey(11), (32, d))
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("expert",))
+    apply_fn = make_a2a_moe_apply(mesh, capacity_factor=4.0, k=2)
+
+    def loss(p):
+        return (apply_fn(p, x) ** 2).mean()
+
+    val, grads = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(val))
+    for g in jax.tree_util.tree_leaves(grads):
+        assert bool(jnp.isfinite(g).all())
