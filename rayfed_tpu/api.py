@@ -65,6 +65,7 @@ def init(
     logging_level: str = "info",
     sender_proxy_cls=None,
     receiver_proxy_cls=None,
+    receiver_sender_proxy_cls=None,
     job_name: Optional[str] = None,
     sending_failure_handler: Optional[Callable[[Exception], None]] = None,
     transport: Optional[str] = None,
@@ -84,6 +85,12 @@ def init(
         logging_level: root logging level.
         sender_proxy_cls / receiver_proxy_cls: custom transport classes
             (the pluggable seam, ref api.py:73-75).
+        receiver_sender_proxy_cls: a combined transport serving both
+            directions behind the party's single advertised port (ref
+            api.py:239-248); overrides the separate sender/receiver
+            classes. ``cross_silo_comm.use_global_proxy=False`` registers
+            proxies under job-suffixed names so several jobs' proxies
+            coexist in one process (ref barriers.py:31-85).
         job_name: multi-job isolation name; peers in other jobs get 417.
         sending_failure_handler: called with the last sending error on
             unintended shutdown.
@@ -176,29 +183,62 @@ def init(
         from rayfed_tpu.mesh import init_party_mesh
 
         init_party_mesh(fed_config.PartyMeshConfig.from_dict(party_mesh_dict))
-    default_sender_cls, default_receiver_cls = barriers._default_transport_classes(
-        transport
-    )
-    receiver_proxy_cls = receiver_proxy_cls or default_receiver_cls
-    sender_proxy_cls = sender_proxy_cls or default_sender_cls
+    use_global_proxy = cross_silo_comm_dict.get("use_global_proxy", True)
+    if receiver_sender_proxy_cls is not None:
+        barriers.start_sender_receiver_proxy(
+            addresses=addresses,
+            party=party,
+            job_name=job_name,
+            tls_config=tls_config,
+            proxy_cls=receiver_sender_proxy_cls,
+            proxy_config=cross_silo_comm_dict,
+            ready_timeout_s=cross_silo_comm_config.timeout_in_ms / 1000,
+            use_global_proxy=use_global_proxy,
+        )
+    else:
+        default_sender_cls, default_receiver_cls = (
+            barriers._default_transport_classes(transport)
+        )
+        receiver_proxy_cls = receiver_proxy_cls or default_receiver_cls
+        sender_proxy_cls = sender_proxy_cls or default_sender_cls
 
-    barriers.start_receiver_proxy(
-        addresses=addresses,
-        party=party,
-        job_name=job_name,
-        tls_config=tls_config,
-        proxy_cls=receiver_proxy_cls,
-        proxy_config=cross_silo_comm_dict,
-        ready_timeout_s=cross_silo_comm_config.timeout_in_ms / 1000,
-    )
-    barriers.start_sender_proxy(
-        addresses=addresses,
-        party=party,
-        job_name=job_name,
-        tls_config=tls_config,
-        proxy_cls=sender_proxy_cls,
-        proxy_config=cross_silo_comm_dict,
-    )
+        barriers.start_receiver_proxy(
+            addresses=addresses,
+            party=party,
+            job_name=job_name,
+            tls_config=tls_config,
+            proxy_cls=receiver_proxy_cls,
+            proxy_config=cross_silo_comm_dict,
+            ready_timeout_s=cross_silo_comm_config.timeout_in_ms / 1000,
+            use_global_proxy=use_global_proxy,
+        )
+        barriers.start_sender_proxy(
+            addresses=addresses,
+            party=party,
+            job_name=job_name,
+            tls_config=tls_config,
+            proxy_cls=sender_proxy_cls,
+            proxy_config=cross_silo_comm_dict,
+            use_global_proxy=use_global_proxy,
+        )
+
+    # Opt-in cross-party collective lane: all parties join one
+    # jax.distributed group so FedAvg can lower to a cross-process psum
+    # (collective.fed_collective_mean), gated per-collective on the
+    # control plane. AFTER the proxies: the join blocks on every party
+    # arriving, and this party must stay reachable meanwhile.
+    collective_dict = config.get("collective")
+    if collective_dict is not None:
+        from rayfed_tpu import collective as _collective
+
+        _collective.init_joint_collective(
+            addresses,
+            party,
+            coordinator_address=collective_dict["coordinator"],
+            inner_axes=tuple(collective_dict.get("inner_axes", ("data",))),
+            inner_shape=collective_dict.get("inner_shape"),
+            init_timeout_s=collective_dict.get("init_timeout_s", 120.0),
+        )
 
     if config.get("barrier_on_initializing", False):
         barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
@@ -245,7 +285,12 @@ def _shutdown(intended: bool = True):
 
     internal_kv.kv_reset()
     clear_global_context(wait_for_sending=wait_for_sending)
-    barriers.stop_proxies()
+    barriers.stop_proxies(job_name=ctx.get_job_name())
+    # Only touch the collective lane if it was ever imported — keeps jax
+    # out of control-plane-only processes.
+    _collective = sys.modules.get("rayfed_tpu.collective")
+    if _collective is not None:
+        _collective.clear_joint_collective()
     fed_config.reset_config_cache()
     logger.info("Shutdown rayfed_tpu.")
     signal.signal(signal.SIGINT, original_sigint)

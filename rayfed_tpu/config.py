@@ -89,11 +89,12 @@ class CrossSiloMessageConfig:
     """Transport-independent cross-party messaging knobs
     (ref ``fed/config.py:78-161``).
 
-    Ray-specific reference knobs (``proxy_max_restarts``,
-    ``send_resource_label``, ``recv_resource_label``, ``use_global_proxy`` —
-    ref config.py:98-124) have no meaning for in-process thread proxies;
-    ``from_dict`` silently drops them, so reference-written config dicts
-    still load.
+    Ray-specific reference knobs that have no meaning for in-process
+    thread proxies (``send_resource_label``, ``recv_resource_label`` —
+    ref config.py:98-124) are silently dropped by ``from_dict``, so
+    reference-written config dicts still load. ``proxy_max_restarts``
+    (accept-loop supervision) and ``use_global_proxy`` (per-job proxy
+    registry names, consumed by ``fed.init``) ARE honored.
 
     Attributes:
         timeout_in_ms: per-send timeout (ref default 60000, config.py:126).
@@ -207,14 +208,40 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
             carry the party name as CN. Set False for deployments whose
             certs are host-named rather than party-named (those fall back
             to plain shared-CA trust).
+        per_party_config: optional {dest_party: {field: value}} overrides
+            applied on top of this config for sends to that party (the
+            reference's per-destination messages config seam,
+            ref ``grpc_proxy.py:156-177``).
+        proxy_max_restarts: how many times the receiver's accept loop is
+            restarted after an unexpected crash (the reference maps this
+            to Ray actor ``max_restarts``, ref ``barriers.py:301-307``).
+            0 disables supervision.
     """
 
     retry_policy: Optional[Dict[str, Any]] = None
     connect_timeout_in_ms: int = 10000
     verify_peer_identity: bool = True
+    per_party_config: Optional[Dict[str, Dict[str, Any]]] = None
+    proxy_max_restarts: int = 3
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)
+
+    def for_dest(self, dest_party: Optional[str]) -> "TcpCrossSiloMessageConfig":
+        """The effective config for sends to ``dest_party``: this config
+        with any ``per_party_config[dest_party]`` overrides applied."""
+        overrides = (self.per_party_config or {}).get(dest_party)
+        if not overrides:
+            return self
+        merged = dataclasses.asdict(self)
+        merged.pop("per_party_config", None)
+        field_names = {f.name for f in dataclasses.fields(type(self))}
+        merged.update(
+            {k: v for k, v in overrides.items() if k in field_names}
+        )
+        return type(self)(**{
+            k: v for k, v in merged.items() if k in field_names
+        })
 
 
 # Back-compat alias: the reference spells this GrpcCrossSiloMessageConfig.

@@ -19,12 +19,48 @@ from typing import Dict, Optional, Type
 
 from rayfed_tpu._private.global_context import get_global_context
 from rayfed_tpu.exceptions import FedRemoteError
-from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
+from rayfed_tpu.proxy.base import (
+    ReceiverProxy,
+    SenderProxy,
+    SenderReceiverProxy,
+)
 
 logger = logging.getLogger(__name__)
 
+# "Current" proxies used by module-level send/recv, plus a name-keyed
+# registry so several jobs' proxies can coexist in one process
+# (ref ``fed/proxy/barriers.py:31-85``: job-suffixed actor names when
+# ``use_global_proxy`` is False).
 _sender_proxy: Optional[SenderProxy] = None
 _receiver_proxy: Optional[ReceiverProxy] = None
+_proxy_registry: Dict[str, object] = {}
+
+_SENDER_NAME = "SenderProxy"
+_RECEIVER_NAME = "ReceiverProxy"
+_SENDER_RECEIVER_NAME = "SenderReceiverProxy"
+
+
+def proxy_name(kind: str, job_name: str, use_global_proxy: bool = True) -> str:
+    """Registry name for a proxy — job-suffixed when the job opts out of
+    the global singleton (mirrors ref ``set_proxy_actor_name``)."""
+    base = {
+        "sender": _SENDER_NAME,
+        "receiver": _RECEIVER_NAME,
+        "sender_receiver": _SENDER_RECEIVER_NAME,
+    }[kind]
+    return base if use_global_proxy else f"{base}_{job_name}"
+
+
+def sender_proxy_name(job_name: str, use_global_proxy: bool = True) -> str:
+    return proxy_name("sender", job_name, use_global_proxy)
+
+
+def receiver_proxy_name(job_name: str, use_global_proxy: bool = True) -> str:
+    return proxy_name("receiver", job_name, use_global_proxy)
+
+
+def get_registered_proxy(name: str):
+    return _proxy_registry.get(name)
 
 
 def sender_proxy() -> Optional[SenderProxy]:
@@ -67,6 +103,7 @@ def start_receiver_proxy(
     proxy_cls: Type[ReceiverProxy],
     proxy_config: Optional[Dict] = None,
     ready_timeout_s: float = 60,
+    use_global_proxy: bool = True,
 ) -> None:
     """Start + readiness-check the receiver (ref ``barriers.py:248-281``:
     init blocks until the server bound its port, and a bind failure is an
@@ -78,6 +115,9 @@ def start_receiver_proxy(
     _receiver_proxy.start()
     ok, err = _receiver_proxy.is_ready(timeout=ready_timeout_s)
     assert ok, err
+    _proxy_registry[receiver_proxy_name(job_name, use_global_proxy)] = (
+        _receiver_proxy
+    )
     logger.info("Receiver proxy ready on %s.", addresses[party])
 
 
@@ -88,21 +128,75 @@ def start_sender_proxy(
     tls_config: Optional[Dict],
     proxy_cls: Type[SenderProxy],
     proxy_config: Optional[Dict] = None,
+    use_global_proxy: bool = True,
 ) -> None:
     global _sender_proxy
     _sender_proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
     _sender_proxy.start()
+    _proxy_registry[sender_proxy_name(job_name, use_global_proxy)] = (
+        _sender_proxy
+    )
     logger.info("Sender proxy started.")
 
 
-def stop_proxies() -> None:
+def start_sender_receiver_proxy(
+    addresses: Dict[str, str],
+    party: str,
+    job_name: str,
+    tls_config: Optional[Dict],
+    proxy_cls: Type[SenderReceiverProxy],
+    proxy_config: Optional[Dict] = None,
+    ready_timeout_s: float = 60,
+    use_global_proxy: bool = True,
+) -> None:
+    """Start one object serving both directions on the party's single
+    advertised port (ref ``barriers.py:415-459``). It registers under ONE
+    name and is installed as both the current sender and receiver."""
     global _sender_proxy, _receiver_proxy
+    proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
+    proxy.start()
+    ok, err = proxy.is_ready(timeout=ready_timeout_s)
+    assert ok, err
+    _sender_proxy = proxy
+    _receiver_proxy = proxy
+    _proxy_registry[
+        proxy_name("sender_receiver", job_name, use_global_proxy)
+    ] = proxy
+    logger.info("Sender-receiver proxy ready on %s.", addresses[party])
+
+
+def stop_proxies(job_name: Optional[str] = None) -> None:
+    """Stop the current proxies; with ``job_name``, also drop that job's
+    registry entries (global-named entries are dropped when they point at
+    the stopped objects)."""
+    global _sender_proxy, _receiver_proxy
+    stopped = set()
     if _sender_proxy is not None:
         _sender_proxy.stop()
+        stopped.add(id(_sender_proxy))
         _sender_proxy = None
     if _receiver_proxy is not None:
         _receiver_proxy.stop()
+        stopped.add(id(_receiver_proxy))
         _receiver_proxy = None
+    job_names = (
+        set()
+        if job_name is None
+        else {
+            f"{base}_{job_name}"
+            for base in (_SENDER_NAME, _RECEIVER_NAME, _SENDER_RECEIVER_NAME)
+        }
+    )
+    for name in list(_proxy_registry):
+        obj = _proxy_registry[name]
+        if id(obj) in stopped:
+            del _proxy_registry[name]
+        elif name in job_names:  # exact match — "_a" must not hit "prod_a"
+            try:
+                obj.stop()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                logger.warning("failed to stop proxy %s", name, exc_info=True)
+            del _proxy_registry[name]
 
 
 def send(

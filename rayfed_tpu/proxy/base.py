@@ -55,6 +55,56 @@ class SenderProxy(abc.ABC):
         pass
 
 
+class SenderReceiverProxy(abc.ABC):
+    """One object serving both directions on one inbound port (ref
+    ``fed/proxy/base_proxy.py:77-106``) — the seam transports with a
+    single bidirectional link (e.g. secretflow's brpc link) plug into.
+    Injected via ``fed.init(receiver_sender_proxy_cls=...)``."""
+
+    def __init__(
+        self,
+        addresses: Dict[str, str],
+        party: str,
+        job_name: str,
+        tls_config: Optional[Dict],
+        proxy_config: Optional[Dict] = None,
+    ) -> None:
+        self._addresses = addresses
+        self._party = party
+        self._job_name = job_name
+        self._tls_config = tls_config or {}
+        self._proxy_config = proxy_config or {}
+
+    @abc.abstractmethod
+    def start(self) -> None:
+        """Bind the inbound port and spin up sending machinery."""
+
+    @abc.abstractmethod
+    def is_ready(self, timeout: Optional[float] = None):
+        """(ok, error_message_or_None) once the inbound port is bound."""
+
+    @abc.abstractmethod
+    def send(
+        self,
+        dest_party: str,
+        data,
+        upstream_seq_id,
+        downstream_seq_id,
+        is_error: bool = False,
+    ) -> Future:
+        """Same contract as :meth:`SenderProxy.send`."""
+
+    @abc.abstractmethod
+    def get_data(self, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
+        """Same contract as :meth:`ReceiverProxy.get_data`."""
+
+    def get_stats(self) -> Dict:
+        return {}
+
+    def stop(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
 class ReceiverProxy(abc.ABC):
     def __init__(
         self,

@@ -42,7 +42,11 @@ from rayfed_tpu._private.constants import (
 from rayfed_tpu.config import TcpCrossSiloMessageConfig
 from rayfed_tpu.exceptions import FedLocalError
 from rayfed_tpu.proxy import rendezvous
-from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
+from rayfed_tpu.proxy.base import (
+    ReceiverProxy,
+    SenderProxy,
+    SenderReceiverProxy,
+)
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
 from rayfed_tpu.proxy.tcp import sockio, wire
 
@@ -63,6 +67,8 @@ class _DestWorker(threading.Thread):
         super().__init__(name=f"fedtpu-send-{dest_party}", daemon=True)
         self._proxy = proxy
         self._dest = dest_party
+        # Per-destination effective config (ref grpc_proxy.py:156-177).
+        self._cfg = proxy._config.for_dest(dest_party)
         self._jobs: Queue = Queue()
         self._sock: Optional[socket.socket] = None
         self._closed = False
@@ -73,7 +79,7 @@ class _DestWorker(threading.Thread):
             # ssl.SSLSocket cannot be read and written concurrently.
             from rayfed_tpu.proxy.tcp.pipeline import PipelinedLane
 
-            policy = proxy._config.get_retry_policy()
+            policy = self._cfg.get_retry_policy()
 
             def bump_acks() -> None:
                 proxy._bump_stat("send_op_count")
@@ -82,7 +88,7 @@ class _DestWorker(threading.Thread):
                 dest_party,
                 connect=lambda attempts: self._fresh_sock(attempts),
                 max_attempts=policy.max_attempts,
-                ack_timeout_s=proxy._config.timeout_in_ms / 1000,
+                ack_timeout_s=self._cfg.timeout_in_ms / 1000,
                 on_ack=bump_acks,
             )
         self.start()
@@ -100,7 +106,7 @@ class _DestWorker(threading.Thread):
 
     def _connect_once(self, op_timeout: Optional[float] = -1) -> socket.socket:
         host, port = _parse_addr(self._proxy._addresses[self._dest])
-        cfg = self._proxy._config
+        cfg = self._cfg
         raw = socket.create_connection(
             (host, port), timeout=cfg.connect_timeout_in_ms / 1000
         )
@@ -117,7 +123,7 @@ class _DestWorker(threading.Thread):
                        op_timeout) -> socket.socket:
         """Connect with the retry policy. ``op_timeout`` is the blocking-op
         timeout installed on the resulting socket (-1 = config default)."""
-        policy = self._proxy._config.get_retry_policy()
+        policy = self._cfg.get_retry_policy()
         attempts = max_attempts or policy.max_attempts
         backoff = policy.initial_backoff_ms / 1000
         last_err: Optional[Exception] = None
@@ -148,7 +154,7 @@ class _DestWorker(threading.Thread):
         writer/reader threads; the lane maps idle reader timeouts back to
         'keep waiting' when nothing is in flight."""
         return self._connect_retry(
-            max_attempts, op_timeout=self._proxy._config.timeout_in_ms / 1000
+            max_attempts, op_timeout=self._cfg.timeout_in_ms / 1000
         )
 
     def _get_sock(self, max_attempts: Optional[int] = None) -> socket.socket:
@@ -213,7 +219,7 @@ class _DestWorker(threading.Thread):
             value = data
 
         kind, meta, buffers = serialization.encode_payload(value)
-        cfg = self._proxy._config
+        cfg = self._cfg
         if kind == "pickle" and not cfg.allow_pickle_payloads and not is_error:
             raise ValueError(
                 "payload requires pickling but allow_pickle_payloads=False "
@@ -243,7 +249,7 @@ class _DestWorker(threading.Thread):
         # rides gRPC's in-channel retry policy for this), a reconnect
         # after a stale connection gets one try, so the total budget
         # stays ~2x the policy rather than attempts^2.
-        cfg = self._proxy._config
+        cfg = self._cfg
         policy = cfg.get_retry_policy()
         backoff = policy.initial_backoff_ms / 1000
         last_err: Optional[BaseException] = None
@@ -323,8 +329,9 @@ class TcpSenderProxy(SenderProxy):
         return dict(self._stats)
 
     def get_proxy_config(self, dest_party: Optional[str] = None):
-        """Expose the effective messaging config (ref grpc_proxy.py:170-177)."""
-        return self._config
+        """The effective messaging config — per-destination overrides
+        applied when ``dest_party`` is given (ref grpc_proxy.py:156-177)."""
+        return self._config.for_dest(dest_party)
 
     def stop(self) -> None:
         with self._lock:
@@ -361,19 +368,22 @@ class TcpReceiverProxy(ReceiverProxy):
 
     # -- lifecycle ------------------------------------------------------------
 
-    def start(self) -> None:
+    def _bind_listener(self) -> None:
         host, port = _parse_addr(self._listen_addr)
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((host, port))
+        listener.listen(64)
+        self._listener = listener
+
+    def start(self) -> None:
         try:
-            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            listener.bind((host, port))
-            listener.listen(64)
+            self._bind_listener()
         except OSError as e:
             self._ready_result = (
                 False, f"failed to bind {self._listen_addr}: {e}"
             )
             return
-        self._listener = listener
         self._ready_result = (True, None)
         threading.Thread(
             target=self._accept_loop,
@@ -417,6 +427,41 @@ class TcpReceiverProxy(ReceiverProxy):
     # -- data path -------------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        """Accept loop with crash supervision: an unexpected failure
+        restarts the listener up to ``proxy_max_restarts`` times (the
+        reference delegates this to Ray actor restarts,
+        ref ``barriers.py:301-307``)."""
+        restarts_left = max(0, self._config.proxy_max_restarts)
+        while not self._stopping:
+            try:
+                self._accept_once()
+                return  # listener closed deliberately
+            except Exception as e:  # noqa: BLE001 - supervised
+                if self._stopping or restarts_left <= 0:
+                    if not self._stopping:
+                        logger.error(
+                            "receiver accept loop died (restarts "
+                            "exhausted): %s", e,
+                        )
+                    return
+                restarts_left -= 1
+                logger.warning(
+                    "receiver accept loop crashed (%s); restarting "
+                    "listener (%d restarts left)", e, restarts_left,
+                )
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                try:
+                    self._bind_listener()
+                except OSError as bind_err:
+                    logger.error(
+                        "could not rebind receiver listener: %s", bind_err
+                    )
+                    return
+
+    def _accept_once(self) -> None:
         ssl_ctx = (
             wire.make_server_ssl_context(self._tls_config)
             if wire.tls_enabled(self._tls_config)
@@ -426,7 +471,11 @@ class TcpReceiverProxy(ReceiverProxy):
             try:
                 conn, peer = self._listener.accept()
             except OSError:
-                return  # listener closed
+                if self._stopping:
+                    return  # listener closed deliberately
+                # Unexpected accept failure (EMFILE/ENOBUFS/...): let the
+                # supervisor restart the listener instead of going deaf.
+                raise
             threading.Thread(
                 target=self._serve_conn,
                 args=(conn, peer, ssl_ctx),
@@ -501,3 +550,47 @@ class TcpReceiverProxy(ReceiverProxy):
                 conn.close()
             except OSError:
                 pass
+
+
+class TcpSenderReceiverProxy(SenderReceiverProxy):
+    """Both directions behind one object and one inbound port (ref
+    ``fed/proxy/base_proxy.py:77-106`` / ``barriers.py:415-459``): the
+    receiver half serves ``addresses[party]``; the sender half dials the
+    peers. Outbound connections use ephemeral ports as usual — "one port"
+    is the party's single advertised endpoint."""
+
+    def __init__(self, addresses, party, job_name, tls_config,
+                 proxy_config=None):
+        super().__init__(addresses, party, job_name, tls_config, proxy_config)
+        self._receiver = TcpReceiverProxy(
+            addresses[party], party, job_name, tls_config, proxy_config
+        )
+        self._sender = TcpSenderProxy(
+            addresses, party, job_name, tls_config, proxy_config
+        )
+
+    def start(self) -> None:
+        self._receiver.start()
+        self._sender.start()
+
+    def is_ready(self, timeout=None):
+        return self._receiver.is_ready(timeout)
+
+    def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
+             is_error: bool = False) -> Future:
+        return self._sender.send(
+            dest_party, data, upstream_seq_id, downstream_seq_id, is_error
+        )
+
+    def get_data(self, src_party, upstream_seq_id, curr_seq_id) -> Future:
+        return self._receiver.get_data(src_party, upstream_seq_id, curr_seq_id)
+
+    def get_proxy_config(self, dest_party=None):
+        return self._sender.get_proxy_config(dest_party)
+
+    def get_stats(self) -> Dict:
+        return {**self._sender.get_stats(), **self._receiver.get_stats()}
+
+    def stop(self) -> None:
+        self._sender.stop()
+        self._receiver.stop()
