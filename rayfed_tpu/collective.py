@@ -72,12 +72,12 @@ def party_axis_mesh(n_parties: int, devices=None, inner_axes=("data",),
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "party_axis", "op", "acc_dtype")
+    jax.jit,
+    static_argnames=("mesh", "party_axis", "op", "acc_dtype", "specs"),
 )
 def _cross_party_reduce(tree, mesh: Mesh, party_axis: str, op: str,
-                        acc_dtype: Optional[str]):
+                        acc_dtype: Optional[str], specs):
     n_parties = mesh.shape[party_axis]
-    other_axes = tuple(a for a in mesh.axis_names if a != party_axis)
 
     def body(local_tree):
         def leaf(x):
@@ -92,14 +92,32 @@ def _cross_party_reduce(tree, mesh: Mesh, party_axis: str, op: str,
         return jax.tree_util.tree_map(leaf, local_tree)
 
     # Party-sharded in, party-sharded (replicated value) out: every party's
-    # sub-mesh ends up holding the identical aggregate.
-    spec = P(party_axis)
+    # sub-mesh ends up holding the identical aggregate. Leaves keep their
+    # inner-axis sharding through the reduce.
+    treedef = jax.tree_util.tree_structure(tree)
+    spec_tree = jax.tree_util.tree_unflatten(treedef, list(specs))
     return shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec,),
-        out_specs=spec,
+        in_specs=(spec_tree,),
+        out_specs=spec_tree,
     )(tree)
+
+
+def _leaf_spec(x, mesh: Mesh, party_axis: str):
+    s = getattr(x, "sharding", None)
+    if (
+        isinstance(s, NamedSharding)
+        and len(s.spec) > 0
+        and s.spec[0] == party_axis
+        and all(
+            n in mesh.axis_names
+            for e in s.spec
+            for n in ([] if e is None else [e] if isinstance(e, str) else e)
+        )
+    ):
+        return P(*s.spec)
+    return P(party_axis)
 
 
 def cross_party_reduce(tree, mesh: Mesh, party_axis: str = "party",
@@ -108,10 +126,16 @@ def cross_party_reduce(tree, mesh: Mesh, party_axis: str = "party",
     over ``party_axis``; each party's slot receives the aggregate.
 
     Leaves must have shape ``(n_parties, ...)`` with the leading dim sharded
-    on the party axis (use :func:`stack_party_tree` to build them).
+    on the party axis (use :func:`stack_party_tree` to build them); inner
+    dims may additionally be sharded over the mesh's other axes, and that
+    layout is preserved through the reduce.
     """
     assert op in ("mean", "sum"), op
-    return _cross_party_reduce(tree, mesh, party_axis, op, acc_dtype)
+    specs = tuple(
+        _leaf_spec(x, mesh, party_axis)
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+    return _cross_party_reduce(tree, mesh, party_axis, op, acc_dtype, specs)
 
 
 def stack_party_tree(per_party_trees, mesh: Mesh, party_axis: str = "party"):
@@ -139,3 +163,317 @@ def cross_party_mean(per_party_trees, mesh: Optional[Mesh] = None,
     reduced = cross_party_reduce(stacked, mesh, party_axis, op="mean")
     # Every party slot now holds the aggregate; slot 0 is representative.
     return jax.tree_util.tree_map(lambda x: x[0], reduced)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process joint collective (VERDICT r1 #4 / SURVEY §7 hard part #1)
+# ---------------------------------------------------------------------------
+#
+# Real parties are separate OS processes. Opt-in via
+# ``fed.init(config={"collective": {"coordinator": "host:port"}})``: all
+# parties join ONE jax.distributed process group (process ranks follow the
+# sorted party order), after which ``fed_collective_mean`` lowers FedAvg to
+# a cross-process psum over DCN/ICI — gated on a control-plane rendezvous
+# so the owner-push perimeter survives: a party's shard enters the
+# collective only after every peer announced the same collective id over
+# the ordinary data plane, and peers that never announce fail the call
+# instead of wedging XLA inside a half-entered collective.
+
+import itertools
+import threading as _threading
+
+_joint_lock = _threading.Lock()
+_joint_mesh: Optional[Mesh] = None
+_joint_party_order = None
+_joint_self_party: Optional[str] = None
+_collective_seq = itertools.count(1)
+
+
+def init_joint_collective(
+    addresses,
+    self_party: str,
+    coordinator_address: str,
+    inner_axes=("data",),
+    inner_shape=None,
+    init_timeout_s: float = 120.0,
+) -> Optional[Mesh]:
+    """Join the cross-party jax.distributed group and build the joint
+    party mesh. Process rank = index in sorted party order; jax assigns
+    global device ids by rank, so the mesh's leading ``party`` rows line
+    up with each process's local devices.
+
+    Best-effort: if the group cannot form within ``init_timeout_s`` (a
+    party missing the collective config, network issues), this logs a
+    warning and returns None — ``fed_collective_mean`` then negotiates
+    everyone onto the push lane instead of half the parties wedging.
+    """
+    import logging
+    import time
+
+    global _joint_mesh, _joint_party_order, _joint_self_party
+    party_order = sorted(addresses)
+    rank = party_order.index(self_party)
+    log = logging.getLogger(__name__)
+
+    # Pre-flight over OUR control plane before touching jax.distributed:
+    # its join (and the first jax.devices()) can block without honoring
+    # timeouts when a party never arrives, so nobody enters it until every
+    # party confirmed it is about to. A party missing the collective
+    # config simply never confirms, and the others degrade cleanly here.
+    from rayfed_tpu.proxy import barriers
+
+    peers = [p for p in party_order if p != self_party]
+    for p in peers:
+        barriers.send(
+            p, {"join": "collective"},
+            upstream_seq_id=f"coljoin:{self_party}",
+            downstream_seq_id="coljoin",
+        )
+    deadline = time.monotonic() + init_timeout_s
+    for p in peers:
+        fut = barriers.receiver_proxy().get_data(p, f"coljoin:{p}", "coljoin")
+        try:
+            fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        except Exception:  # noqa: BLE001 - degrade to push lane
+            log.warning(
+                "party %s did not confirm joining the collective group "
+                "within %.0fs; FedAvg stays on the push lane.",
+                p, init_timeout_s,
+            )
+            return None
+
+    try:
+        if not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=len(party_order),
+                process_id=rank,
+                initialization_timeout=max(1, int(init_timeout_s)),
+            )
+    except Exception as e:  # noqa: BLE001 - degrade to push lane
+        log.warning(
+            "joint collective group did not form (%s); FedAvg stays on "
+            "the push lane.", e,
+        )
+        return None
+    with _joint_lock:
+        _joint_mesh = party_axis_mesh(
+            len(party_order), devices=jax.devices(),
+            inner_axes=tuple(inner_axes), inner_shape=inner_shape,
+        )
+        _joint_party_order = party_order
+        _joint_self_party = self_party
+    return _joint_mesh
+
+
+def joint_collective_ready() -> bool:
+    return _joint_mesh is not None
+
+
+def clear_joint_collective() -> None:
+    """Forget the joint mesh (fed.shutdown). The jax.distributed group
+    itself outlives the fed runtime — platform/process-group choices are
+    process-wide and irreversible (see mesh.init_distributed)."""
+    global _joint_mesh, _joint_party_order, _joint_self_party
+    with _joint_lock:
+        _joint_mesh = None
+        _joint_party_order = None
+        _joint_self_party = None
+
+
+def _stack_local_shard(leaf, mesh: Mesh, party_axis: str):
+    """Global (n_parties, ...) array whose party slots are each process's
+    local tree — built from THIS process's data only (other parties' slots
+    live on their devices).
+
+    When the leaf is already a ``jax.Array`` sharded on axes the joint
+    mesh shares, its tiles are re-used device-to-device and the stacked
+    array keeps that inner sharding (no host round-trip, no per-device
+    replication of a leaf that only fits sharded). Host/numpy leaves are
+    staged once and replicated across the party's local devices.
+    """
+    import numpy as np
+
+    n_parties = mesh.shape[party_axis]
+    global_shape = (n_parties,) + tuple(int(d) for d in leaf.shape)
+
+    sharding_in = getattr(leaf, "sharding", None)
+    if isinstance(sharding_in, NamedSharding) and getattr(
+        leaf, "is_fully_addressable", False
+    ):
+        inner_spec = tuple(sharding_in.spec)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+        def spec_ok(entry):
+            names = (
+                [] if entry is None
+                else [entry] if isinstance(entry, str) else list(entry)
+            )
+            return all(
+                n in sizes and sizes[n] == sharding_in.mesh.shape[n]
+                for n in names
+            )
+
+        if all(spec_ok(e) for e in inner_spec):
+            target = NamedSharding(mesh, P(party_axis, *inner_spec))
+
+            def norm(idx, shape):
+                return tuple(
+                    (0 if s.start is None else int(s.start),
+                     dim if s.stop is None else int(s.stop))
+                    for s, dim in zip(idx, shape)
+                )
+
+            tiles = {
+                norm(s.index, leaf.shape): s.data
+                for s in leaf.addressable_shards
+            }
+            arrays = []
+            for d, idx in target.addressable_devices_indices_map(
+                global_shape
+            ).items():
+                tile = tiles.get(norm(idx[1:], leaf.shape))
+                if tile is None:
+                    arrays = None
+                    break  # layouts disagree -> host path below
+                arrays.append(jax.device_put(tile[None], d))
+            if arrays is not None:
+                return jax.make_array_from_single_device_arrays(
+                    global_shape, target, arrays
+                )
+
+    local = np.asarray(leaf)
+    sharding = NamedSharding(mesh, P(party_axis))
+    slab = local[None]
+    arrays = [
+        jax.device_put(slab, d) for d in sharding.addressable_devices
+    ]
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrays
+    )
+
+
+def fed_collective_mean(
+    local_tree,
+    collective_id: Optional[str] = None,
+    timeout_s: float = 120.0,
+    party_axis: str = "party",
+):
+    """Cross-party FedAvg over the joint process group.
+
+    Every party calls this with its own local tree (multi-controller, same
+    program line). Control-plane gating: each party pushes a tiny intent
+    frame for ``collective_id`` to every peer and waits for all peers'
+    intents before entering the psum — a peer that never opts in causes a
+    TimeoutError here, on the control plane, instead of a hang inside the
+    collective. Without a joint group the call falls back to the push
+    lane (``federated.fed_aggregate`` + broadcast), same math.
+
+    Returns the aggregate tree (identical bytes in every party, XLA's
+    fixed reduction order).
+    """
+    import time
+
+    from rayfed_tpu._private.global_context import get_global_context
+
+    ctx = get_global_context()
+    assert ctx is not None, "fed.init() first"
+    if collective_id is None:
+        collective_id = f"auto{next(_collective_seq)}"
+
+    from rayfed_tpu.api import _get_addresses
+    from rayfed_tpu.proxy import barriers
+
+    addresses = _get_addresses(ctx.get_job_name())
+    self_party = ctx.get_current_party()
+    peers = sorted(p for p in addresses if p != self_party)
+    my_lane = "psum" if joint_collective_ready() else "push"
+
+    # Announce intent + lane: edge key (col:<id>:<sender>, col:<id>) is
+    # unique per sender; both sides may arrive in any order (rendezvous
+    # store). Exchanging the LANE too keeps mixed deployments convergent:
+    # if any party lacks the joint group, everyone takes the push lane
+    # rather than half the parties wedging inside a psum.
+    for p in peers:
+        barriers.send(
+            p, {"collective": collective_id, "lane": my_lane},
+            upstream_seq_id=f"col:{collective_id}:{self_party}",
+            downstream_seq_id=f"col:{collective_id}",
+        )
+    waits = {
+        p: barriers.receiver_proxy().get_data(
+            p, f"col:{collective_id}:{p}", f"col:{collective_id}"
+        )
+        for p in peers
+    }
+    # One shared deadline across all peers — not timeout_s per peer.
+    deadline = time.monotonic() + timeout_s
+    lanes = {self_party: my_lane}
+    for p, fut in waits.items():
+        try:
+            ack = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        except Exception as e:  # noqa: BLE001 - surfaced with context
+            raise TimeoutError(
+                f"party {p} never announced collective {collective_id!r}; "
+                "not entering the psum (control-plane gate)"
+            ) from e
+        if ack.get("collective") != collective_id:
+            raise RuntimeError(
+                f"party {p} announced {ack.get('collective')!r}, "
+                f"expected {collective_id!r} — program divergence"
+            )
+        lanes[p] = ack.get("lane", "psum")
+
+    if any(lane != "psum" for lane in lanes.values()):
+        return _push_lane_mean(local_tree)
+
+    mesh = _joint_mesh
+    stacked = jax.tree_util.tree_map(
+        lambda x: _stack_local_shard(x, mesh, party_axis), local_tree
+    )
+    reduced = cross_party_reduce(stacked, mesh, party_axis, op="mean")
+    return jax.tree_util.tree_map(_local_aggregate, reduced)
+
+
+def _local_aggregate(x):
+    """This party's aggregate from a reduced global (n_parties, ...) leaf:
+    assembled host-side from the local (possibly inner-sharded) tiles."""
+    import numpy as np
+
+    shards = list(x.addressable_shards)
+    first = np.asarray(shards[0].data)[0]
+    if len(shards) == 1:
+        return first
+    indices = {
+        tuple(
+            (0 if s.start is None else s.start, s.stop)
+            for s in sh.index
+        )
+        for sh in shards
+    }
+    if len(indices) == 1:
+        return first  # replicated across the party's devices
+    out = np.empty(x.shape[1:], x.dtype)
+    for sh in shards:
+        out[tuple(sh.index[1:])] = np.asarray(sh.data)[0]
+    return out
+
+
+def _push_lane_mean(local_tree):
+    """Push-lane fallback: identity task per party -> hierarchical
+    fed_aggregate -> broadcast via fed.get."""
+    import rayfed_tpu as fed
+    from rayfed_tpu._private.global_context import get_global_context
+    from rayfed_tpu.api import _get_addresses
+    from rayfed_tpu.federated import fed_aggregate
+
+    addresses = _get_addresses(get_global_context().get_job_name())
+    parties = sorted(addresses)
+
+    @fed.remote
+    def _own_tree(t):
+        return t
+
+    objs = {p: _own_tree.party(p).remote(local_tree) for p in parties}
+    agg = fed_aggregate(objs, op="mean")
+    return fed.get(agg)
