@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rayfed_tpu.models import transformer as tfm
 
@@ -128,3 +128,91 @@ def make_pp_loss_fn(
         )
 
     return loss_fn
+
+
+def make_pp_train_step(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    *,
+    stage_axis: str = "stage",
+    party_axis=None,
+    data_axis=None,
+    n_microbatches: int = 4,
+    microbatch_group: int = 0,
+    lr: float = 3e-4,
+):
+    """Full pp(x tp)(x dp)(x party) training step in ONE jit over ``mesh``.
+
+    The pipeline schedule is manual over ``stage_axis`` only; every other
+    mesh axis stays GSPMD-automatic, so Megatron-sharded params (``model``
+    axis, via ``parallel.sharding``) and batch sharding over
+    ``party``/``data`` compose with the stage loop in the same program —
+    the party/data grad all-reduce doubles as the federated aggregate
+    exactly as in :func:`rayfed_tpu.parallel.train.make_fed_train_step`.
+
+    ``microbatch_group`` > 0 runs the schedule in groups of that many
+    microbatches under a gradient-accumulation scan with the group body
+    rematerialized: in-flight activations are bounded by the group size
+    instead of the full microbatch count — the memory bound 1F1B provides
+    — at the cost of one pipeline fill/drain per group (the classic
+    schedule trade; a fused fwd/bwd interleave would cut the extra
+    bubbles too).
+    """
+    import optax
+
+    from rayfed_tpu.parallel import sharding as shd
+    from rayfed_tpu.parallel.train import make_optimizer
+
+    optimizer = make_optimizer(lr)
+    groups = 1
+    per_group = n_microbatches
+    if microbatch_group:
+        assert n_microbatches % microbatch_group == 0, (
+            n_microbatches, microbatch_group,
+        )
+        groups = n_microbatches // microbatch_group
+        per_group = microbatch_group
+    group_loss = make_pp_loss_fn(
+        cfg, mesh, stage_axis=stage_axis, n_microbatches=per_group
+    )
+
+    batch_axes = tuple(
+        a for a in (party_axis, data_axis) if a and mesh.shape.get(a, 1) > 1
+    )
+    batch_pspec = P(batch_axes if batch_axes else None)
+    batch_sharding = NamedSharding(mesh, batch_pspec)
+
+    def loss_fn(params, inputs, targets):
+        if groups == 1:
+            return group_loss(params, inputs, targets)
+        b = inputs.shape[0]
+        assert b % groups == 0, (b, groups)
+        gi = inputs.reshape(groups, b // groups, -1)
+        gt = targets.reshape(groups, b // groups, -1)
+
+        def acc(total, xs):
+            i, t = xs
+            return total + group_loss(params, i, t), None
+
+        body = jax.checkpoint(acc, prevent_cse=False)
+        total, _ = lax.scan(body, jnp.float32(0.0), (gi, gt))
+        return total / groups
+
+    def step(params, opt_state, inputs, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_fn(rng, sample_tokens):
+        params = tfm.init_params(rng, cfg)
+        params = shd.shard_params(mesh, params)
+        opt_state = jax.jit(optimizer.init)(params)
+        return params, opt_state
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(None, None, batch_sharding, batch_sharding),
+        donate_argnums=(0, 1),
+    )
+    return init_fn, step_fn
