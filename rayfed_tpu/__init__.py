@@ -9,7 +9,14 @@ aggregation that lowers to XLA collectives (see SURVEY.md §7).
 """
 
 from rayfed_tpu import tree_util  # noqa: F401  (must precede api import)
-from rayfed_tpu.api import get, init, kill, remote, shutdown  # noqa: F401
+from rayfed_tpu.api import (  # noqa: F401
+    get,
+    init,
+    is_party_leader,
+    kill,
+    remote,
+    shutdown,
+)
 from rayfed_tpu.exceptions import FedRemoteError  # noqa: F401
 from rayfed_tpu.fed_object import FedObject  # noqa: F401
 from rayfed_tpu.proxy.barriers import recv, send  # noqa: F401
@@ -23,6 +30,7 @@ __all__ = [
     "kill",
     "shutdown",
     "send",
+    "is_party_leader",
     "recv",
     "FedObject",
     "FedRemoteError",

@@ -50,9 +50,8 @@ def _signal_handler(signum, frame):
     if signum == signal.SIGINT:
         signal.signal(signal.SIGINT, original_sigint)
         logger.warning(
-            "Stop signal received (e.g. via SIGINT/Ctrl+C), "
-            "try to shutdown fed. Press CTRL+C "
-            "(or send SIGINT/SIGKILL/SIGTERM) to skip."
+            "Interrupt caught - draining pending cross-party sends before "
+            "exit; interrupt again to abort the drain."
         )
         _shutdown(intended=False)
 
@@ -96,9 +95,11 @@ def init(
             unintended shutdown.
         transport: 'tcp' (default), 'tpu', or 'grpc'.
     """
-    assert addresses, "Addresses should be provided."
-    assert party, "Party should be provided."
-    assert party in addresses, f"Party {party} is not in the addresses {addresses}."
+    assert addresses, "fed.init needs addresses={party: 'host:port', ...}"
+    assert party, "fed.init needs party=<this party's name>"
+    assert party in addresses, (
+        f"party {party!r} has no entry in addresses ({sorted(addresses)})"
+    )
     config = config or {}
 
     if job_name is None:
@@ -123,6 +124,17 @@ def init(
             "arrays-only mode."
         )
 
+    # Multi-host party: config['jax_distributed'] = {coordinator_address,
+    # num_processes, process_id} joins THIS party's hosts into one jax
+    # process group. Process 0 is the party leader — it alone owns the
+    # wire; followers run the same program for the jitted multi-host
+    # computation (SURVEY §2 "party = JAX multi-controller process group").
+    jax_dist = config.get("jax_distributed")
+    party_process_id = int(jax_dist.get("process_id", 0)) if jax_dist else 0
+    party_num_processes = (
+        int(jax_dist.get("num_processes", 1)) if jax_dist else 1
+    )
+
     init_global_context(
         job_name=job_name,
         current_party=party,
@@ -131,6 +143,8 @@ def init(
         continue_waiting_for_data_sending_on_error=(
             cross_silo_comm_config.continue_waiting_for_data_sending_on_error
         ),
+        party_process_id=party_process_id,
+        party_num_processes=party_num_processes,
     )
 
     tls_config = {} if tls_config is None else tls_config
@@ -139,6 +153,16 @@ def init(
             "cert" in tls_config and "key" in tls_config
         ), "Cert or key are not in tls_config."
 
+    kv_store = config.get("kv_store")
+    if kv_store is not None:
+        # Shared (file-backed) KV so every host process of a multi-host
+        # party reads the same cluster/job config; only the leader clears
+        # it on shutdown.
+        internal_kv.kv_configure(
+            backend=kv_store.get("backend", "memory"),
+            path=kv_store.get("path"),
+            clear_on_reset=party_process_id == 0,
+        )
     internal_kv.kv_initialize(job_name)
     cluster_config = {
         constants.KEY_OF_CLUSTER_ADDRESSES: addresses,
@@ -173,7 +197,6 @@ def init(
     # task is jit-compiled on it (SURVEY.md §3.1 "In a TPU build `init`
     # additionally establishes the party-slice mesh"). A multi-host party
     # first joins its jax.distributed process group.
-    jax_dist = config.get("jax_distributed")
     if jax_dist is not None:
         from rayfed_tpu.mesh import init_distributed
 
@@ -184,7 +207,15 @@ def init(
 
         init_party_mesh(fed_config.PartyMeshConfig.from_dict(party_mesh_dict))
     use_global_proxy = cross_silo_comm_dict.get("use_global_proxy", True)
-    if receiver_sender_proxy_cls is not None:
+    if party_process_id != 0:
+        # Follower host of a multi-host party: the leader owns the wire
+        # (listen port, sends, receives); this process only executes the
+        # party's jitted computation.
+        logger.info(
+            "Joined party %s as follower host %d; proxies stay on the "
+            "leader.", party, party_process_id,
+        )
+    elif receiver_sender_proxy_cls is not None:
         barriers.start_sender_receiver_proxy(
             addresses=addresses,
             party=party,
@@ -240,7 +271,7 @@ def init(
             init_timeout_s=collective_dict.get("init_timeout_s", 120.0),
         )
 
-    if config.get("barrier_on_initializing", False):
+    if config.get("barrier_on_initializing", False) and party_process_id == 0:
         barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
 
 
@@ -338,7 +369,10 @@ class FedRemoteFunction:
 
     def remote(self, *args, **kwargs):
         if not self._node_party:
-            raise ValueError("You should specify a party name on the fed function.")
+            raise ValueError(
+                "call .party(<name>) before .remote(): a fed task needs an "
+                "executing party"
+            )
         return self._fed_call_holder.internal_remote(*args, **kwargs)
 
     def _execute_impl(self, args, kwargs):
@@ -399,13 +433,16 @@ def remote(*args, **kwargs):
         if inspect.isclass(function_or_class):
             return FedRemoteClass(function_or_class).options(**options)
         raise TypeError(
-            "The @fed.remote decorator must be applied to either a function "
-            "or a class."
+            f"@fed.remote expects a function or class, got "
+            f"{type(function_or_class).__name__}"
         )
 
     if len(args) == 1 and len(kwargs) == 0 and callable(args[0]):
         return _make_fed_remote(args[0])
-    assert len(args) == 0 and len(kwargs) > 0, "Remote args error."
+    assert not args and kwargs, (
+        "use @fed.remote bare or with keyword options only, e.g. "
+        "@fed.remote(num_returns=2)"
+    )
     return lambda fn_or_cls: _make_fed_remote(fn_or_cls, **kwargs)
 
 
@@ -426,12 +463,14 @@ def get(
     """Resolve FedObjects to real values; the owner broadcasts to every
     other party (ref api.py:531-608 — `get` is itself a DAG node with a
     fresh seq id so all parties address the same edges)."""
-    fake_fed_task_id = get_global_context().next_seq_id()
+    # get() is itself a node in the DAG: it burns one seq id so every
+    # party addresses the broadcast edges identically.
+    consumer_seq_id = get_global_context().next_seq_id()
     job_name = get_global_context().get_job_name()
     addresses = _get_addresses(job_name)
     current_party = _get_party(job_name)
-    is_individual_id = isinstance(fed_objects, FedObject)
-    if is_individual_id:
+    single = isinstance(fed_objects, FedObject)
+    if single:
         fed_objects = [fed_objects]
 
     futures = []
@@ -450,7 +489,7 @@ def get(
                     dest_party=party_name,
                     data=fut,
                     upstream_seq_id=fed_object.get_fed_task_id(),
-                    downstream_seq_id=fake_fed_task_id,
+                    downstream_seq_id=consumer_seq_id,
                 )
         else:
             if fed_object.get_value_future() is not None:
@@ -460,24 +499,38 @@ def get(
                     current_party,
                     fed_object.get_party(),
                     fed_object.get_fed_task_id(),
-                    fake_fed_task_id,
+                    consumer_seq_id,
                 )
                 fed_object._cache_value_future(fut)
             futures.append(fut)
 
     try:
         values = [f.result() for f in futures]
-        if is_individual_id:
-            values = values[0]
-        return values
+        return values[0] if single else values
     except FedRemoteError as e:
         logger.warning(
-            "Encountered RemoteError from another party, error message: %s",
+            "A peer party's task failed; re-raising its error envelope: %s",
             e.cause,
         )
         if get_global_context() is not None:
             get_global_context().set_last_received_error(e)
         raise
+
+
+def is_party_leader() -> bool:
+    """True on the host that owns this party's wire (host 0 of a
+    multi-host party; always True for single-process parties).
+
+    Raises if the fed runtime is not initialized — silently answering
+    True on every host before ``fed.init`` (or after shutdown) would send
+    all hosts down leader-only code paths."""
+    ctx = get_global_context()
+    if ctx is None:
+        raise RuntimeError(
+            "is_party_leader() needs an initialized fed runtime "
+            "(call fed.init() first)"
+        )
+    return ctx.is_party_leader()
 
 
 def kill(actor: FedActorHandle, *, no_restart: bool = True):
