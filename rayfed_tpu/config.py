@@ -216,6 +216,10 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
             restarted after an unexpected crash (the reference maps this
             to Ray actor ``max_restarts``, ref ``barriers.py:301-307``).
             0 disables supervision.
+        send_window: max unacknowledged frames in flight on the pipelined
+            (plaintext) sender lane; bounds resend memory at
+            window x payload size. 1 degenerates to half-duplex
+            request-response.
     """
 
     retry_policy: Optional[Dict[str, Any]] = None
@@ -223,6 +227,7 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
     verify_peer_identity: bool = True
     per_party_config: Optional[Dict[str, Dict[str, Any]]] = None
     proxy_max_restarts: int = 3
+    send_window: int = 8
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)

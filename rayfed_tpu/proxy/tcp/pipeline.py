@@ -30,8 +30,10 @@ from rayfed_tpu.proxy.tcp import sockio, wire
 
 logger = logging.getLogger(__name__)
 
-# Max unacknowledged frames in flight. Payload buffers stay referenced until
-# acked, so this bounds resend memory at WINDOW x payload size.
+# Default max unacknowledged frames in flight (config knob: send_window).
+# Payload buffers stay referenced until acked, so the window bounds resend
+# memory at window x payload size — 8 x 100MB = 800MB worst case; lower it
+# for memory-tight hosts, raise it for high-BDP links.
 WINDOW = 8
 
 
@@ -58,6 +60,7 @@ class PipelinedLane:
         max_attempts: int,
         ack_timeout_s: float,
         on_ack: Callable[[], None],
+        window: int = WINDOW,
     ):
         self._dest = dest
         self._connect = connect
@@ -68,7 +71,7 @@ class PipelinedLane:
         self._jobs: Queue = Queue()
         self._lock = threading.Lock()
         self._inflight: deque = deque()
-        self._window = threading.Semaphore(WINDOW)
+        self._window = threading.Semaphore(max(1, window))
         self._sock: Optional[socket.socket] = None
         self._broken = True
         self._closed = False

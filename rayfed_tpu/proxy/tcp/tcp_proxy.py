@@ -90,6 +90,7 @@ class _DestWorker(threading.Thread):
                 max_attempts=policy.max_attempts,
                 ack_timeout_s=self._cfg.timeout_in_ms / 1000,
                 on_ack=bump_acks,
+                window=self._cfg.send_window,
             )
         self.start()
 

@@ -208,3 +208,27 @@ def test_per_dest_proxy_config():
     assert fut.result(30).shape == (4,)
     sp.stop()
     rp.stop()
+
+
+def test_send_window_configurable():
+    """send_window plumbs through to the pipelined lane; window=1 behaves
+    as half-duplex and still delivers."""
+    addrs = get_addresses(["bob"])
+    rp = TcpReceiverProxy(addrs["bob"], "bob", "job", None, dict(FAST))
+    rp.start()
+    assert rp.is_ready()[0]
+    sp = TcpSenderProxy(addrs, "alice", "job", None,
+                        dict(FAST, send_window=1))
+    sp.start()
+    futs = [rp.get_data("alice", f"{i}#0", i) for i in range(6)]
+    sends = [
+        sp.send("bob", np.full((32,), i, np.float32), f"{i}#0", i)
+        for i in range(6)
+    ]
+    assert all(f.result(30) for f in sends)
+    for i, f in enumerate(futs):
+        assert f.result(30)[0] == i
+    worker = sp._workers["bob"]
+    assert worker._lane._window._value <= 1  # window restored after acks
+    sp.stop()
+    rp.stop()
