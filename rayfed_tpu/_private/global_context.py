@@ -22,9 +22,18 @@ class GlobalContext:
         sending_failure_handler: Optional[Callable[[Exception], None]] = None,
         exit_on_sending_failure: bool = False,
         continue_waiting_for_data_sending_on_error: bool = False,
+        party_process_id: int = 0,
+        party_num_processes: int = 1,
     ) -> None:
         self._job_name = job_name
         self._current_party = current_party
+        # A party spanning several host processes elects process 0 the
+        # leader: it alone owns the wire (proxies, sends); received
+        # cross-party values are relayed to follower hosts over the
+        # party's coordination service so every host can feed them into
+        # the jitted multi-host computation.
+        self._party_process_id = party_process_id
+        self._party_num_processes = party_num_processes
         self._seq_count = 0
         self._seq_lock = threading.Lock()
         self._sending_failure_handler = sending_failure_handler
@@ -55,6 +64,15 @@ class GlobalContext:
 
     def get_current_party(self) -> str:
         return self._current_party
+
+    def get_party_process_id(self) -> int:
+        return self._party_process_id
+
+    def get_party_num_processes(self) -> int:
+        return self._party_num_processes
+
+    def is_party_leader(self) -> bool:
+        return self._party_process_id == 0
 
     # -- deterministic DAG numbering (ref global_context.py:45-47) --------
     def next_seq_id(self) -> int:
@@ -117,6 +135,8 @@ def init_global_context(
     sending_failure_handler: Optional[Callable[[Exception], None]] = None,
     exit_on_sending_failure: bool = False,
     continue_waiting_for_data_sending_on_error: bool = False,
+    party_process_id: int = 0,
+    party_num_processes: int = 1,
 ) -> GlobalContext:
     global _global_context
     with _context_lock:
@@ -129,6 +149,8 @@ def init_global_context(
                 continue_waiting_for_data_sending_on_error=(
                     continue_waiting_for_data_sending_on_error
                 ),
+                party_process_id=party_process_id,
+                party_num_processes=party_num_processes,
             )
         return _global_context
 

@@ -208,6 +208,13 @@ def send(
 ) -> Future:
     """Fire-and-forget push; completion future is drained asynchronously by
     the cleanup manager (ref ``barriers.py:462-488``)."""
+    ctx = get_global_context()
+    if ctx is not None and not ctx.is_party_leader():
+        # Follower host of a multi-host party: the leader's identical
+        # program performs the one real push for this DAG edge.
+        done: Future = Future()
+        done.set_result(True)
+        return done
     assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
     fut = _sender_proxy.send(
         dest_party, data, upstream_seq_id, downstream_seq_id, is_error=is_error
@@ -220,13 +227,108 @@ def send(
     return fut
 
 
+def _party_relay_client():
+    """The party's coordination-service client, when this party spans
+    several host processes (leader relays received values to followers)."""
+    ctx = get_global_context()
+    if ctx is None or ctx.get_party_num_processes() <= 1:
+        return None
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - no jax / no group
+        return None
+
+
+def _relay_key(job_name: str, upstream_seq_id, curr_seq_id) -> str:
+    return f"fedtpu_relay:{job_name}:{upstream_seq_id}:{curr_seq_id}"
+
+
+def _relay_encode(value) -> bytes:
+    import msgpack
+
+    from rayfed_tpu._private import serialization
+
+    kind, meta, buffers = serialization.encode_payload(value)
+    return msgpack.packb(
+        {"k": kind, "m": meta, "d": serialization.concat_buffers(buffers)},
+        use_bin_type=True,
+    )
+
+
+def _relay_decode(blob: bytes):
+    import msgpack
+
+    from rayfed_tpu._private import serialization
+
+    msg = msgpack.unpackb(blob, raw=False)
+    # Intra-party channel: the bytes come from this party's own leader
+    # over its private coordination service (same trust domain), so the
+    # pickle lane (error envelopes) decodes unrestricted.
+    return serialization.decode_payload(msg["k"], msg["m"], msg["d"])
+
+
 def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
     """Future for data addressed to (upstream_seq_id, curr_seq_id). If the
     payload is a FedRemoteError envelope, the future raises it and the error
-    is recorded on the context (ref ``barriers.py:222-234``)."""
+    is recorded on the context (ref ``barriers.py:222-234``).
+
+    In a multi-host party, the leader performs the one real wire receive
+    and relays the decoded value to follower hosts over the party's
+    coordination service, so every host's copy of the consuming task gets
+    its arguments and the cross-host jitted computation can proceed."""
+    ctx = get_global_context()
+    if ctx is not None and not ctx.is_party_leader():
+        relay = _party_relay_client()
+        out: Future = Future()
+        if relay is None:
+            out.set_exception(RuntimeError(
+                "follower host has no party coordination service to "
+                "receive relayed values from (was jax_distributed "
+                "configured?)"
+            ))
+            return out
+        key = _relay_key(ctx.get_job_name(), upstream_seq_id, curr_seq_id)
+
+        def fetch() -> None:
+            try:
+                blob = relay.blocking_key_value_get_bytes(key, 3600 * 1000)
+                value = _relay_decode(blob)
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+                return
+            if isinstance(value, FedRemoteError):
+                out.set_exception(value)
+            else:
+                out.set_result(value)
+
+        import threading
+
+        threading.Thread(
+            target=fetch, name="fedtpu-relay-recv", daemon=True
+        ).start()
+        return out
+
     assert _receiver_proxy is not None, "receiver proxy not started; call fed.init()"
     raw = _receiver_proxy.get_data(src_party, upstream_seq_id, curr_seq_id)
     out: Future = Future()
+    relay = _party_relay_client()
+    job_name = ctx.get_job_name() if ctx is not None else ""
+
+    def _publish(value) -> None:
+        if relay is None:
+            return
+        try:
+            relay.key_value_set_bytes(
+                _relay_key(job_name, upstream_seq_id, curr_seq_id),
+                _relay_encode(value),
+            )
+        except Exception:  # noqa: BLE001 - followers will time out loudly
+            logger.warning(
+                "failed to relay received value to follower hosts",
+                exc_info=True,
+            )
 
     def _chain(f: Future) -> None:
         try:
@@ -234,6 +336,7 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
         except BaseException as e:  # noqa: BLE001
             out.set_exception(e)
             return
+        _publish(value)
         if isinstance(value, FedRemoteError):
             logger.debug(
                 "Receiving exception from %s: %s; raising to consumer.",
