@@ -45,10 +45,13 @@ def _party_main(party, addresses, transport, result_path):
 
     import rayfed_tpu as fed
 
+    comm = dict(_FAST_RETRY)
+    if os.environ.get("FEDTPU_BENCH_WINDOW"):
+        comm["send_window"] = int(os.environ["FEDTPU_BENCH_WINDOW"])
     fed.init(
         addresses=addresses,
         party=party,
-        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        config={"cross_silo_comm": comm, "transport": transport},
         job_name=f"bench-{transport}",
         logging_level="error",
     )
@@ -158,30 +161,41 @@ def _try_build_fastwire() -> None:
 
 def _try_train_mfu():
     """Flagship train-step MFU on the local accelerator (TPU only) —
-    recorded alongside the push-throughput headline. Best-effort: the
-    transport benchmark stands on its own if this fails."""
+    recorded alongside the push-throughput headline. Runs in a killable
+    subprocess: jax backend init can hang indefinitely when the
+    accelerator service is unhealthy, and the transport benchmark must
+    still print its line."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import sys, json\n"
+        f"sys.path.insert(0, {os.path.join(here, 'benchmarks')!r})\n"
+        "import jax\n"
+        "if jax.default_backend() != 'tpu':\n"
+        "    sys.exit(3)\n"
+        "from contextlib import redirect_stdout\n"
+        "from transformer_train_benchmark import run as train_run\n"
+        "with redirect_stdout(sys.stderr):\n"
+        "    r = train_run(2048, 12, 2048, batch=12, steps=10, vocab=32768)\n"
+        "print(json.dumps({'train_tokens_per_s': round(r['tokens_per_s']),"
+        "'train_mfu': round(r['mfu'], 4),"
+        "'train_n_params': r['n_params'], 'train_seq': r['seq']}))\n"
+    )
     try:
-        import jax
-
-        if jax.default_backend() != "tpu":
+        # Healthy runs need ~150s (compile + 10 steps); a wedged
+        # accelerator service must not eat the driver's whole budget.
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=420, cwd=here,
+        )
+        if proc.returncode != 0:
+            print(
+                f"train MFU bench skipped (rc={proc.returncode}): "
+                f"{proc.stderr[-500:]}", file=sys.stderr,
+            )
             return None
-        sys.path.insert(0, os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
-        ))
-        from contextlib import redirect_stdout
-
-        from transformer_train_benchmark import run as train_run
-
-        # The train bench prints a human-readable line; keep stdout clean
-        # for the driver's single JSON line.
-        with redirect_stdout(sys.stderr):
-            r = train_run(2048, 12, 2048, batch=12, steps=10, vocab=32768)
-        return {
-            "train_tokens_per_s": round(r["tokens_per_s"]),
-            "train_mfu": round(r["mfu"], 4),
-            "train_n_params": r["n_params"],
-            "train_seq": r["seq"],
-        }
+        return json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"train MFU bench skipped: {e!r}", file=sys.stderr)
         return None

@@ -259,6 +259,14 @@ def init(
     # control plane. AFTER the proxies: the join blocks on every party
     # arriving, and this party must stay reachable meanwhile.
     collective_dict = config.get("collective")
+    if collective_dict is not None and party_num_processes > 1:
+        raise ValueError(
+            "config['collective'] and a multi-host party "
+            "(config['jax_distributed']) cannot share a process: the "
+            "party's private process group would be mistaken for the "
+            "joint all-parties group. Aggregate multi-host parties over "
+            "the push lane."
+        )
     if collective_dict is not None:
         from rayfed_tpu import collective as _collective
 

@@ -243,7 +243,21 @@ def init_joint_collective(
             return None
 
     try:
-        if not jax.distributed.is_initialized():
+        if jax.distributed.is_initialized():
+            # A pre-existing process group (e.g. a multi-host party's
+            # private group from config['jax_distributed']) is NOT the
+            # joint all-parties group — psumming over it would aggregate
+            # the wrong set of processes. Refuse rather than mis-reduce.
+            if jax.process_count() != len(party_order):
+                log.warning(
+                    "jax.distributed already initialized with %d processes "
+                    "but the job has %d parties; the collective lane "
+                    "cannot share a process with a different group — "
+                    "FedAvg stays on the push lane.",
+                    jax.process_count(), len(party_order),
+                )
+                return None
+        else:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=len(party_order),

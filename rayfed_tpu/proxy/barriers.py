@@ -245,19 +245,21 @@ def _relay_key(job_name: str, upstream_seq_id, curr_seq_id) -> str:
     return f"fedtpu_relay:{job_name}:{upstream_seq_id}:{curr_seq_id}"
 
 
-def _relay_encode(value) -> bytes:
+def _relay_encode(value, is_error: bool = False) -> bytes:
     import msgpack
 
     from rayfed_tpu._private import serialization
 
     kind, meta, buffers = serialization.encode_payload(value)
     return msgpack.packb(
-        {"k": kind, "m": meta, "d": serialization.concat_buffers(buffers)},
+        {"k": kind, "m": meta, "d": serialization.concat_buffers(buffers),
+         "e": is_error},
         use_bin_type=True,
     )
 
 
 def _relay_decode(blob: bytes):
+    """Returns (value, is_error)."""
     import msgpack
 
     from rayfed_tpu._private import serialization
@@ -266,7 +268,8 @@ def _relay_decode(blob: bytes):
     # Intra-party channel: the bytes come from this party's own leader
     # over its private coordination service (same trust domain), so the
     # pickle lane (error envelopes) decodes unrestricted.
-    return serialization.decode_payload(msg["k"], msg["m"], msg["d"])
+    value = serialization.decode_payload(msg["k"], msg["m"], msg["d"])
+    return value, bool(msg.get("e"))
 
 
 def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
@@ -290,15 +293,34 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
             ))
             return out
         key = _relay_key(ctx.get_job_name(), upstream_seq_id, curr_seq_id)
+        # Honor the job's recv deadline; default to an hour, not forever.
+        from rayfed_tpu.config import TcpCrossSiloMessageConfig, get_job_config
+
+        comm = TcpCrossSiloMessageConfig.from_dict(
+            get_job_config(ctx.get_job_name()).cross_silo_comm_config_dict
+        )
+        timeout_ms = comm.recv_timeout_in_ms or 3600 * 1000
+        n_followers = ctx.get_party_num_processes() - 1
 
         def fetch() -> None:
             try:
-                blob = relay.blocking_key_value_get_bytes(key, 3600 * 1000)
-                value = _relay_decode(blob)
+                blob = relay.blocking_key_value_get_bytes(key, timeout_ms)
+                value, is_error = _relay_decode(blob)
             except BaseException as e:  # noqa: BLE001
                 out.set_exception(e)
                 return
-            if isinstance(value, FedRemoteError):
+            try:
+                # Refcount consumption; the last follower deletes the key
+                # so long-running jobs don't grow coordinator memory by
+                # their whole traffic volume.
+                if relay.key_value_increment(f"{key}:ack", 1) >= n_followers:
+                    relay.key_value_delete(key)
+                    relay.key_value_delete(f"{key}:ack")
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                pass
+            if is_error and isinstance(value, BaseException):
+                if isinstance(value, FedRemoteError):
+                    ctx.set_last_received_error(value)
                 out.set_exception(value)
             else:
                 out.set_result(value)
@@ -316,27 +338,45 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
     relay = _party_relay_client()
     job_name = ctx.get_job_name() if ctx is not None else ""
 
-    def _publish(value) -> None:
+    def _publish(value, is_error: bool = False) -> None:
         if relay is None:
             return
         try:
             relay.key_value_set_bytes(
                 _relay_key(job_name, upstream_seq_id, curr_seq_id),
-                _relay_encode(value),
+                _relay_encode(value, is_error=is_error),
             )
-        except Exception:  # noqa: BLE001 - followers will time out loudly
+        except Exception:  # noqa: BLE001 - fall back to an error marker so
+            # followers fail fast instead of waiting out their deadline.
             logger.warning(
                 "failed to relay received value to follower hosts",
                 exc_info=True,
             )
+            if not is_error:
+                try:
+                    relay.key_value_set_bytes(
+                        _relay_key(job_name, upstream_seq_id, curr_seq_id),
+                        _relay_encode(
+                            RuntimeError(
+                                "leader could not relay the received value "
+                                "(see leader logs)"
+                            ),
+                            is_error=True,
+                        ),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _chain(f: Future) -> None:
         try:
             value = f.result()
         except BaseException as e:  # noqa: BLE001
+            # Followers must learn about wire failures too, or they sit
+            # out their whole relay deadline on a dead edge.
+            _publish(e, is_error=True)
             out.set_exception(e)
             return
-        _publish(value)
+        _publish(value, is_error=isinstance(value, FedRemoteError))
         if isinstance(value, FedRemoteError):
             logger.debug(
                 "Receiving exception from %s: %s; raising to consumer.",
