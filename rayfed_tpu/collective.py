@@ -186,6 +186,10 @@ _joint_lock = _threading.Lock()
 _joint_mesh: Optional[Mesh] = None
 _joint_party_order = None
 _joint_self_party: Optional[str] = None
+# True iff THIS module created the jax.distributed group (the process
+# group outlives fed shutdown; repeat inits may reuse it, foreign groups
+# must not be mistaken for it).
+_joint_group_owned = False
 _collective_seq = itertools.count(1)
 
 
@@ -242,19 +246,20 @@ def init_joint_collective(
             )
             return None
 
+    global _joint_group_owned
     try:
         if jax.distributed.is_initialized():
-            # A pre-existing process group (e.g. a multi-host party's
-            # private group from config['jax_distributed']) is NOT the
-            # joint all-parties group — psumming over it would aggregate
-            # the wrong set of processes. Refuse rather than mis-reduce.
-            if jax.process_count() != len(party_order):
+            # A pre-existing process group is only trustworthy if WE
+            # formed it (repeat fed.init in one process). Anything else —
+            # a multi-host party's private group, a user's own group,
+            # even one whose size coincidentally matches the party count
+            # — is NOT the joint all-parties group; psumming over it
+            # would silently aggregate the wrong set of processes.
+            if not _joint_group_owned:
                 log.warning(
-                    "jax.distributed already initialized with %d processes "
-                    "but the job has %d parties; the collective lane "
-                    "cannot share a process with a different group — "
-                    "FedAvg stays on the push lane.",
-                    jax.process_count(), len(party_order),
+                    "jax.distributed was initialized outside the "
+                    "collective lane; refusing to treat it as the joint "
+                    "all-parties group — FedAvg stays on the push lane.",
                 )
                 return None
         else:
@@ -264,6 +269,7 @@ def init_joint_collective(
                 process_id=rank,
                 initialization_timeout=max(1, int(init_timeout_s)),
             )
+            _joint_group_owned = True
     except Exception as e:  # noqa: BLE001 - degrade to push lane
         log.warning(
             "joint collective group did not form (%s); FedAvg stays on "

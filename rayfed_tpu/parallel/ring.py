@@ -13,6 +13,8 @@ global causal mask is reconstructed from each block's ring-source index.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -32,7 +34,6 @@ def ring_attention(q, k, v, axis_name: str):
     b, s_local, h, dh = q.shape
     scale = dh**-0.5
     q_offset = idx * s_local
-    q32 = q.astype(jnp.float32)
 
     fwd_perm = [(j, (j + 1) % n) for j in range(n)]
 
@@ -41,9 +42,12 @@ def ring_attention(q, k, v, axis_name: str):
         # This k/v block originated at ring position (idx - i) mod n.
         src = (idx - i) % n
         k_offset = src * s_local
+        # Operands stay in storage dtype (bf16 runs the MXU at full rate);
+        # accumulation is f32 via preferred_element_type.
         scores = (
             jnp.einsum(
-                "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+                "bqhd,bkhd->bhqk", q, k_blk,
+                preferred_element_type=jnp.float32,
             )
             * scale
         )
@@ -60,7 +64,8 @@ def ring_attention(q, k, v, axis_name: str):
         # device's own block, whose diagonal is always unmasked.
         l_new = l * alpha + p.sum(axis=-1)
         o_new = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
         )
         k_next = lax.ppermute(k_blk, axis_name, fwd_perm)
         v_next = lax.ppermute(v_blk, axis_name, fwd_perm)
@@ -82,3 +87,128 @@ def reference_attention_for_tests(q, k, v):
     from rayfed_tpu.models.transformer import causal_attention
 
     return causal_attention(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring + flash: the Pallas kernels inside the ring
+# ---------------------------------------------------------------------------
+#
+# The dense ring above materializes (S_local x S_local) f32 scores per
+# step. This lane runs each ring step through the flash kernels instead —
+# O(S_local) memory on-device — and merges the per-block partials by
+# logsumexp. The ring loop is a Python unroll (the axis size is static
+# under shard_map), so each step's relative query offset is a static
+# kernel parameter; steps whose k/v block lies entirely in this device's
+# future are masked out of the merge (their true offset would be
+# negative, i.e. fully non-causal).
+
+
+def _merge_partials(o_acc, lse_acc, o_i, lse_i):
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+    w_i = jnp.exp(lse_i - lse_new)[..., None]
+    return o_acc * w_acc + o_i.astype(jnp.float32) * w_i, lse_new
+
+
+def ring_flash_attention(q, k, v, axis_name: str,
+                         block_q: int = 512, block_k: int = 512,
+                         interpret=None):
+    """Causal ring attention with Pallas flash blocks; differentiable.
+
+    Same contract as :func:`ring_attention` (call inside shard_map over
+    ``axis_name`` with (B, S_local, H, Dh) shards); backward rotates
+    dk/dv accumulators around the ring with the blocks, so gradients
+    arrive home after the full circle.
+    """
+    from rayfed_tpu.ops.flash_attention import _pow2_block
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s_local = q.shape[1]
+    block_q = _pow2_block(s_local, cap=block_q)
+    block_k = _pow2_block(s_local, cap=block_k)
+    return _ring_flash(q, k, v, axis_name, block_q, block_k, bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, block_q, block_k, interpret):
+    out, _ = _ring_flash_fwd_impl(
+        q, k, v, axis_name, block_q, block_k, interpret
+    )
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    from rayfed_tpu.ops.flash_attention import _flash_fwd_raw
+
+    n = lax.psum(1, axis_name)  # static under shard_map
+    idx = lax.axis_index(axis_name)
+    b, s_local, h, dh = q.shape
+    fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+
+    o_acc = jnp.zeros((b, s_local, h, dh), jnp.float32)
+    lse_acc = jnp.full((b, s_local, h), _NEG_BIG, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):
+        # Block held this step originated i hops back: contributions are
+        # causal only on devices with idx >= i (else the block is from
+        # this device's future and fully masked).
+        o_i, lse_i = _flash_fwd_raw(
+            q, k_blk, v_blk, block_q, block_k, interpret,
+            q_offset=i * s_local,
+        )
+        valid = idx >= i
+        lse_i = jnp.where(valid, lse_i, _NEG_BIG)
+        o_i = jnp.where(valid, o_i, 0)
+        o_acc, lse_acc = _merge_partials(o_acc, lse_acc, o_i, lse_i)
+        if i + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, fwd_perm)
+            v_blk = lax.ppermute(v_blk, axis_name, fwd_perm)
+    return o_acc.astype(q.dtype), lse_acc
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(
+        q, k, v, axis_name, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, block_q, block_k, interpret, res, do):
+    from rayfed_tpu.ops.flash_attention import _flash_bwd_pallas
+
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+
+    dq_acc = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    k_blk, v_blk = k, v
+    for i in range(n):
+        dq_i, dk_i, dv_i = _flash_bwd_pallas(
+            q, k_blk, v_blk, out, lse, do, block_q, block_k, interpret,
+            q_offset=i * s_local,
+        )
+        valid = idx >= i
+        dq_acc = dq_acc + jnp.where(valid, dq_i, 0).astype(jnp.float32)
+        dk_acc = dk_acc + jnp.where(valid, dk_i, 0).astype(jnp.float32)
+        dv_acc = dv_acc + jnp.where(valid, dv_i, 0).astype(jnp.float32)
+        # dk/dv accumulators travel WITH the blocks: after the remaining
+        # rotations each tile's gradients arrive back at its owner. The
+        # k/v shards themselves are never read again on the last step.
+        if i + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, fwd_perm)
+            v_blk = lax.ppermute(v_blk, axis_name, fwd_perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, fwd_perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, fwd_perm)
+    return (
+        dq_acc.astype(q.dtype),
+        dk_acc.astype(k.dtype),
+        dv_acc.astype(v.dtype),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)

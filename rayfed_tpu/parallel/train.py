@@ -55,31 +55,33 @@ def make_fed_train_step(
     flash kernel (O(S) memory, differentiable), ``"xla"`` = the dense
     reference attention, ``"auto"`` (default) = flash on TPU backends,
     dense elsewhere (the kernel's interpret mode is test-speed only).
-    When the ``seq`` axis is sharded, ring attention takes precedence and
-    ``attn`` is ignored (its per-block attention is the dense kernel).
+    When the ``seq`` axis is sharded, attention runs as ring attention
+    over that axis; with flash selected, each ring step runs through the
+    Pallas kernels (``ring_flash_attention``) so per-device memory stays
+    O(S_local) even at very long context.
     """
     optimizer = make_optimizer(lr)
     use_ring = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     if attn not in ("auto", "flash", "xla"):
         raise ValueError(f"attn must be 'auto', 'flash', or 'xla'; got {attn!r}")
-    requested_flash = attn == "flash"
     if attn == "auto":
         attn = "flash" if jax.default_backend() == "tpu" else "xla"
-    if use_ring and requested_flash:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "attn='flash' is ignored: the sharded %r axis routes attention "
-            "through the ring lane (dense per-shard blocks).", seq_axis
-        )
 
     if use_ring:
         # Sequence-parallel attention: shard_map over the seq axis with K/V
         # ring rotation; every other axis stays GSPMD-automatic.
+        from rayfed_tpu.parallel.ring import ring_flash_attention
+
+        block_attn = (
+            functools.partial(ring_flash_attention, axis_name=seq_axis)
+            if attn == "flash"
+            else functools.partial(ring_attention, axis_name=seq_axis)
+        )
+
         def ring_attn(q, k, v):
             pspec = P(None, seq_axis, None, None)
             return shard_map(
-                functools.partial(ring_attention, axis_name=seq_axis),
+                block_attn,
                 mesh=mesh,
                 in_specs=(pspec, pspec, pspec),
                 out_specs=pspec,

@@ -158,3 +158,86 @@ def test_remat_matches_non_remat():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
         )
+
+
+def test_ring_flash_attention_matches_reference():
+    """Flash kernels inside the ring (VERDICT long-context lane): forward
+    equals the dense reference across sequence shards."""
+    from rayfed_tpu.parallel.ring import ring_flash_attention
+
+    rng = jax.random.PRNGKey(5)
+    b, s, h, dh = 2, 64, 2, 16
+    q, k, v = (
+        jax.random.normal(key, (b, s, h, dh), jnp.float32)
+        for key in jax.random.split(rng, 3)
+    )
+    expect = tfm.causal_attention(q, k, v)
+    mesh = seq_mesh(4)
+    pspec = P(None, "seq", None, None)
+    ringf = shard_map(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, axis_name="seq", block_q=8, block_k=8
+        ),
+        mesh=mesh,
+        in_specs=(pspec, pspec, pspec),
+        out_specs=pspec,
+        check_vma=False,
+    )
+    got = jax.jit(ringf)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_attention_gradients_match_reference():
+    """Backward: the rotating dk/dv accumulators deliver each block's
+    gradients home; dq/dk/dv equal autodiff through dense attention."""
+    from rayfed_tpu.parallel.ring import ring_flash_attention
+
+    rng = jax.random.PRNGKey(6)
+    b, s, h, dh = 1, 32, 2, 16
+    q, k, v = (
+        jax.random.normal(key, (b, s, h, dh), jnp.float32)
+        for key in jax.random.split(rng, 3)
+    )
+    mesh = seq_mesh(4)
+    pspec = P(None, "seq", None, None)
+    ringf = shard_map(
+        lambda q, k, v: ring_flash_attention(
+            q, k, v, axis_name="seq", block_q=8, block_k=8
+        ),
+        mesh=mesh,
+        in_specs=(pspec, pspec, pspec),
+        out_specs=pspec,
+        check_vma=False,
+    )
+
+    def loss_ring(q, k, v):
+        return (ringf(q, k, v) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (tfm.causal_attention(q, k, v) ** 2).sum()
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    ge = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, ge):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=3e-4, atol=3e-4
+        )
+
+
+def test_fed_train_step_ring_flash():
+    """Full train step with sp=ring+flash: finite loss, params move."""
+    from rayfed_tpu.parallel.train import make_fed_train_step
+
+    cfg = tfm.tiny_config()
+    mesh = Mesh(
+        np.array(jax.devices()).reshape(2, 2, 2), ("party", "data", "seq")
+    )
+    init_fn, step_fn = make_fed_train_step(
+        cfg, mesh, seq_axis="seq", attn="flash", lr=1e-2
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0, cfg.vocab)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    params, opt_state = init_fn(jax.random.PRNGKey(0), inputs)
+    params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
+    assert np.isfinite(float(loss)), float(loss)
