@@ -1,0 +1,174 @@
+"""Input pipeline: tokenized LM data -> device-resident sharded batches.
+
+The reference ships no data loading (its engine moves opaque payloads);
+training frameworks need one, so this module provides the TPU-shaped
+essentials without new dependencies:
+
+ - :class:`TokenDataset`: a flat token array (in-memory or ``np.memmap``)
+   cut into (seq+1)-length windows, shuffled deterministically per epoch;
+ - :func:`make_batch_iterator`: yields ``(inputs, targets)`` pairs already
+   ``device_put`` onto the mesh with the train step's batch sharding, with
+   one batch of host->device transfer prefetched ahead of compute (the
+   standard TPU double-buffering trick);
+ - federated usage: each party constructs its own dataset over its own
+   shard of the corpus — data never crosses the perimeter; the engine's
+   batch sharding (party x data) then makes XLA's grad all-reduce the
+   federated aggregate (see ``parallel/train.py``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class TokenDataset:
+    """Deterministically-shuffled (seq+1)-token windows over a flat corpus.
+
+    ``tokens`` may be any 1-D integer array-like, including ``np.memmap``
+    (corpora larger than RAM stream from disk page-by-page).
+    """
+
+    def __init__(self, tokens, seq_len: int, seed: int = 0) -> None:
+        self._tokens = np.asarray(tokens) if not hasattr(
+            tokens, "dtype"
+        ) else tokens
+        assert self._tokens.ndim == 1, "tokens must be a flat 1-D array"
+        self._window = seq_len + 1  # inputs + shifted targets
+        self._n_windows = len(self._tokens) // self._window
+        assert self._n_windows > 0, (
+            f"corpus of {len(self._tokens)} tokens is shorter than one "
+            f"window ({self._window})"
+        )
+        self._seed = seed
+        self.seq_len = seq_len
+
+    def __len__(self) -> int:
+        return self._n_windows
+
+    def epoch(self, epoch: int) -> Iterator[np.ndarray]:
+        """Windows of one epoch in a deterministic per-epoch order."""
+        order = np.random.RandomState(
+            (self._seed * 1_000_003 + epoch) % (2**31)
+        ).permutation(self._n_windows)
+        for w in order:
+            start = int(w) * self._window
+            yield np.asarray(self._tokens[start: start + self._window])
+
+    def batches(self, batch: int, epoch: int = 0,
+                drop_remainder: bool = True) -> Iterator[np.ndarray]:
+        """(batch, seq+1) int32 blocks from one epoch."""
+        buf = []
+        for window in self.epoch(epoch):
+            buf.append(window)
+            if len(buf) == batch:
+                yield np.stack(buf).astype(np.int32)
+                buf = []
+        if buf and not drop_remainder:
+            yield np.stack(buf).astype(np.int32)
+
+
+def make_batch_iterator(
+    dataset: TokenDataset,
+    batch: int,
+    mesh,
+    batch_pspec=None,
+    *,
+    epochs: Optional[int] = None,
+    start_epoch: int = 0,
+    prefetch: int = 1,
+) -> Iterator[Tuple]:
+    """Device-resident ``(inputs, targets)`` pairs, transfer-prefetched.
+
+    A loader thread stages the next ``prefetch`` batches host->device
+    (``jax.device_put`` with the mesh batch sharding) while the current
+    step computes, hiding transfer latency behind the MXU. ``epochs=None``
+    iterates forever; the epoch schedule is deterministic, so a restarted
+    job can resume at ``start_epoch``.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    if batch_pspec is None:
+        from rayfed_tpu.parallel import sharding as shd
+
+        batch_pspec = shd.batch_spec(mesh)
+    sharding = NamedSharding(mesh, batch_pspec)
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+    stop = threading.Event()
+    _END = object()          # clean end-of-stream
+
+    class _LoaderError:
+        # Errors cross the thread boundary explicitly: a dead loader must
+        # surface its exception at the training loop, not masquerade as a
+        # clean end of data.
+        def __init__(self, exc: BaseException) -> None:
+            self.exc = exc
+
+    def loader() -> None:
+        epoch = start_epoch
+        try:
+            while not stop.is_set() and (
+                epochs is None or epoch < start_epoch + epochs
+            ):
+                for block in dataset.batches(batch, epoch=epoch):
+                    if stop.is_set():
+                        return
+                    pair = (
+                        jax.device_put(block[:, :-1], sharding),
+                        jax.device_put(block[:, 1:], sharding),
+                    )
+                    q.put(pair)
+                epoch += 1
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            if not stop.is_set():
+                q.put(_LoaderError(e))
+
+    thread = threading.Thread(
+        target=loader, name="fedtpu-data-loader", daemon=True
+    )
+    thread.start()
+
+    class _Iter:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            item = q.get()
+            if item is _END:
+                raise StopIteration
+            if isinstance(item, _LoaderError):
+                raise RuntimeError("data loader failed") from item.exc
+            return item
+
+        def close(self) -> None:
+            stop.set()
+            # Keep draining until the loader exits: a put-blocked loader
+            # needs our get to wake up and observe the stop flag.
+            while thread.is_alive():
+                try:
+                    q.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+
+    return _Iter()
+
+
+def synthetic_lm_dataset(vocab: int, n_tokens: int, seq_len: int,
+                         seed: int = 0) -> TokenDataset:
+    """Random-token corpus for benchmarks and tests."""
+    rng = np.random.RandomState(seed)
+    return TokenDataset(
+        rng.randint(0, vocab, size=n_tokens).astype(np.int32),
+        seq_len, seed=seed,
+    )

@@ -1,0 +1,76 @@
+"""Input-pipeline tests: deterministic shuffled windows, sharded
+prefetching batches, end-to-end with the fused train step."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rayfed_tpu.data import TokenDataset, make_batch_iterator, synthetic_lm_dataset
+
+
+def test_windows_cover_corpus_deterministically():
+    ds = TokenDataset(np.arange(100, dtype=np.int32), seq_len=9, seed=7)
+    assert len(ds) == 10
+    e0_a = [w.tolist() for w in ds.epoch(0)]
+    e0_b = [w.tolist() for w in ds.epoch(0)]
+    e1 = [w.tolist() for w in ds.epoch(1)]
+    assert e0_a == e0_b  # same epoch -> same order
+    assert e0_a != e1   # different epoch -> different order
+    # Every window is a contiguous 10-token slice; together they tile the
+    # corpus.
+    starts = sorted(w[0] for w in e0_a)
+    assert starts == [i * 10 for i in range(10)]
+    for w in e0_a:
+        assert w == list(range(w[0], w[0] + 10))
+
+
+def test_batches_shapes_and_remainder():
+    ds = TokenDataset(np.arange(100, dtype=np.int32), seq_len=9)
+    blocks = list(ds.batches(4, epoch=0))
+    assert [b.shape for b in blocks] == [(4, 10), (4, 10)]  # remainder dropped
+    blocks = list(ds.batches(4, epoch=0, drop_remainder=False))
+    assert [b.shape for b in blocks] == [(4, 10), (4, 10), (2, 10)]
+
+
+def test_iterator_yields_sharded_device_pairs():
+    ds = synthetic_lm_dataset(vocab=64, n_tokens=16 * 17, seq_len=16)
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    it = make_batch_iterator(ds, batch=8, mesh=mesh, batch_pspec=P("data"),
+                             epochs=1)
+    n = 0
+    for inputs, targets in it:
+        assert inputs.shape == (8, 16) and targets.shape == (8, 16)
+        assert inputs.sharding.spec == P("data")
+        np.testing.assert_array_equal(
+            np.asarray(inputs)[:, 1:], np.asarray(targets)[:, :-1]
+        )
+        n += 1
+    assert n == 2  # 16 windows / batch 8
+    it.close()
+
+
+def test_pipeline_feeds_train_step():
+    from rayfed_tpu.models import transformer as tfm
+    from rayfed_tpu.parallel import sharding as shd
+    from rayfed_tpu.parallel.train import make_fed_train_step
+
+    cfg = tfm.tiny_config()
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("party", "data"))
+    init_fn, step_fn = make_fed_train_step(cfg, mesh, lr=1e-2)
+    ds = synthetic_lm_dataset(cfg.vocab, n_tokens=8 * 17 * 3, seq_len=16)
+    it = make_batch_iterator(
+        ds, batch=8, mesh=mesh, batch_pspec=shd.batch_spec(mesh), epochs=1
+    )
+    inputs, targets = next(iter(it))
+    params, opt_state = init_fn(jax.random.PRNGKey(0), inputs)
+    steps = 0
+    losses = []
+    for inputs, targets in it:
+        params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
+        losses.append(float(loss))
+        steps += 1
+    assert steps == 2  # 24 windows -> 3 batches, 1 consumed above
+    assert all(np.isfinite(x) for x in losses)
+    it.close()
