@@ -33,9 +33,7 @@ class TokenDataset:
     """
 
     def __init__(self, tokens, seq_len: int, seed: int = 0) -> None:
-        self._tokens = np.asarray(tokens) if not hasattr(
-            tokens, "dtype"
-        ) else tokens
+        self._tokens = np.asarray(tokens)  # no-copy for ndarray/memmap
         assert self._tokens.ndim == 1, "tokens must be a flat 1-D array"
         self._window = seq_len + 1  # inputs + shifted targets
         self._n_windows = len(self._tokens) // self._window
@@ -65,10 +63,10 @@ class TokenDataset:
         for window in self.epoch(epoch):
             buf.append(window)
             if len(buf) == batch:
-                yield np.stack(buf).astype(np.int32)
+                yield np.stack(buf).astype(np.int32, copy=False)
                 buf = []
         if buf and not drop_remainder:
-            yield np.stack(buf).astype(np.int32)
+            yield np.stack(buf).astype(np.int32, copy=False)
 
 
 def make_batch_iterator(
@@ -135,18 +133,39 @@ def make_batch_iterator(
     thread.start()
 
     class _Iter:
+        def __init__(self):
+            self._done = False
+
         def __iter__(self):
             return self
 
         def __next__(self):
-            item = q.get()
+            # A finished stream stays finished: q.get() with no live
+            # producer would block forever, so exhaustion/close/error all
+            # latch _done and keep raising StopIteration.
+            if self._done:
+                raise StopIteration
+            while True:
+                # Re-check _done between bounded gets: a concurrent
+                # close() from another thread drains the queue (possibly
+                # eating _END), and a get() with no deadline would then
+                # block this consumer forever.
+                try:
+                    item = q.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if self._done:
+                        raise StopIteration from None
             if item is _END:
+                self._done = True
                 raise StopIteration
             if isinstance(item, _LoaderError):
+                self._done = True
                 raise RuntimeError("data loader failed") from item.exc
             return item
 
         def close(self) -> None:
+            self._done = True
             stop.set()
             # Keep draining until the loader exits: a put-blocked loader
             # needs our get to wake up and observe the stop flag.
@@ -160,6 +179,22 @@ def make_batch_iterator(
                     q.get_nowait()
                 except queue.Empty:
                     break
+
+        # Dropping the iterator without close() (break out of a `for`
+        # over the infinite epochs=None stream) must not leak the loader
+        # thread or the prefetched device batches it holds.
+        def __del__(self):
+            try:
+                self.close()
+            except BaseException:  # noqa: BLE001 - interpreter teardown
+                pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self.close()
+            return False
 
     return _Iter()
 

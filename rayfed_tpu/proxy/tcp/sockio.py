@@ -10,8 +10,11 @@ through StreamReader). Frame layout is defined in
 
 from __future__ import annotations
 
+import os
 import socket
 import ssl
+import sys
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import msgpack
@@ -90,6 +93,96 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 _SEGMENT_THRESHOLD = 1 << 20
 
 
+class BufferPool:
+    """Recycles large receive buffers across frames.
+
+    A fresh ``np.empty`` per 100MB frame costs ~40% of loopback throughput
+    on this class of host: glibc serves big allocations from per-thread
+    arenas that always mmap >64MB requests, so every frame pays page
+    faults on first touch plus munmap on free. Delivered arrays are
+    zero-copy views of the receive buffer, so a buffer is safe to reuse
+    exactly when every consumer view has died — detected by its refcount
+    dropping back to the pool's own reference.
+    """
+
+    def __init__(
+        self, max_bytes: int, min_size: int = 1 << 20, max_entries: int = 64
+    ):
+        # Free detection relies on exact refcounts; a free-threaded
+        # interpreter biases/defers them, so pooling must stand down
+        # there (plain allocation, no dead-weight cache).
+        if not getattr(sys, "_is_gil_enabled", lambda: True)():
+            max_bytes = 0  # pragma: no cover - nogil builds only
+        self._max_bytes = max_bytes
+        self._min_size = min_size
+        # Bounds the O(entries) refcount scan every take() pays under the
+        # lock (and with it, worst-case lock hold time).
+        self._max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: List = []  # np.ndarray blocks, oldest first
+        self._total = 0  # running sum of tracked bytes
+
+    # refs to a free entry at the getrefcount() call site: the pool's
+    # list slot + getrefcount's argument. Any live consumer view (ndarray
+    # slice / memoryview chains back to the block) adds more.
+    _FREE_RC = 2
+
+    def take(self, n: int):
+        """A writable 1-d uint8 array of exactly ``n`` bytes."""
+        import numpy as np
+
+        if n < self._min_size or n > self._max_bytes:
+            return np.empty(n, dtype=np.uint8)
+        with self._lock:
+            best = -1
+            for i in range(len(self._entries)):
+                nbytes = self._entries[i].nbytes
+                # <=4n bound: don't burn a huge block on a small frame.
+                if (
+                    n <= nbytes <= (n << 2)
+                    and sys.getrefcount(self._entries[i]) == self._FREE_RC
+                    and (best < 0 or nbytes < self._entries[best].nbytes)
+                ):
+                    best = i
+            if best >= 0:
+                block = self._entries.pop(best)
+                self._entries.append(block)  # LRU: reused = most recent
+                return block[:n] if block.nbytes > n else block[:]
+        # Allocate outside the lock: mmap + page faults of a GB-scale
+        # block must not stall other receiver threads' pool hits.
+        block = np.empty(n, dtype=np.uint8)
+        evicted = []
+        with self._lock:
+            self._entries.append(block)
+            self._total += block.nbytes
+            while len(self._entries) > 1 and (
+                self._total > self._max_bytes
+                or len(self._entries) > self._max_entries
+            ):
+                # Evict oldest-first; a busy block is merely untracked and
+                # is freed by GC once its consumers drop their views.
+                self._total -= self._entries[0].nbytes
+                evicted.append(self._entries.pop(0))
+        del evicted  # munmap of evicted blocks happens after lock release
+        return block[:]
+
+
+def _pool_max_bytes() -> int:
+    mb = os.environ.get("FEDTPU_RECV_POOL_MB")
+    try:
+        return max(0, int(mb)) << 20 if mb is not None else 2 << 30
+    except ValueError:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring malformed FEDTPU_RECV_POOL_MB=%r (want integer MB)", mb
+        )
+        return 2 << 30
+
+
+_RECV_POOL = BufferPool(_pool_max_bytes())
+
+
 def recv_frame(
     sock: socket.socket,
     max_payload: Optional[int] = None,
@@ -115,11 +208,9 @@ def recv_frame(
     header = msgpack.unpackb(bytes(_recv_exact(sock, hlen)), raw=False)
     if not plen:
         return ftype, header, memoryview(b"")
-    # np.empty skips the zero-fill a bytearray would pay (~47ms/100MB —
-    # pure waste since recv_into overwrites every byte) and halves page
-    # traffic on fresh buffers; the returned view stays writable.
-    import numpy as np
-
+    # Buffers come from the recycling pool (np.empty also skips the
+    # zero-fill a bytearray would pay — pure waste since recv_into
+    # overwrites every byte); the returned view stays writable.
     from rayfed_tpu._private import serialization
 
     if plen >= _SEGMENT_THRESHOLD and header.get("pkind") == "tree":
@@ -130,12 +221,12 @@ def recv_frame(
             segments = []
             pos = 0
             for n in lengths:
-                buf = np.empty(n, dtype=np.uint8)
+                buf = _RECV_POOL.take(n)
                 _recv_exact_into(sock, memoryview(buf))
                 segments.append((pos, buf))
                 pos += n
             return ftype, header, serialization.SegmentedPayload(segments)
 
-    payload = np.empty(plen, dtype=np.uint8)
+    payload = _RECV_POOL.take(plen)
     _recv_exact_into(sock, memoryview(payload))
     return ftype, header, memoryview(payload)

@@ -81,3 +81,81 @@ def test_fastwire_timeout():
         sockio._recv_exact_into(b, memoryview(buf))
     a.close()
     b.close()
+
+class TestBufferPool:
+    def test_small_requests_bypass_pool(self):
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=1 << 20)
+        a = pool.take(100)
+        assert a.nbytes == 100
+        assert pool._entries == []
+
+    def test_reuse_after_views_die(self):
+        import weakref
+
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=16)
+        a = pool.take(1024)
+        block = weakref.ref(a.base)  # a strong ref would block reuse
+        assert block() is not None
+        del a  # consumer dropped every view
+        b = pool.take(1024)
+        assert b.base is block()  # same block recycled
+        assert len(pool._entries) == 1
+
+    def test_no_reuse_while_view_alive(self):
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=16)
+        a = pool.take(1024)
+        a[:] = 7
+        b = pool.take(1024)  # a still alive -> must get a fresh block
+        b[:] = 9
+        assert a.base is not b.base
+        assert (a == 7).all()
+
+    def test_derived_numpy_view_keeps_block_busy(self):
+        # The delivery path hands consumers np.frombuffer views of the
+        # recv buffer; those must keep the block out of the free list.
+        import weakref
+
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=16)
+        a = pool.take(1024)
+        a[:] = 3
+        consumer = np.frombuffer(memoryview(a), dtype=np.uint8)
+        block = weakref.ref(a.base)
+        del a
+        b = pool.take(1024)
+        assert b.base is not block()  # consumer view keeps block busy
+        b[:] = 9
+        assert (consumer == 3).all()  # consumer data untouched
+        del consumer
+        d = pool.take(1024)
+        assert d.base is block()  # freed once the view died
+
+    def test_size_tolerance_bounds_waste(self):
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=16)
+        a = pool.take(64 * 1024)
+        block = a.base
+        del a
+        small = pool.take(64)  # far below 1/4 of the block: no reuse
+        assert small.base is not block
+
+    def test_eviction_caps_tracked_bytes(self):
+        import weakref
+
+        pool = sockio.BufferPool(max_bytes=4096, min_size=16)
+        # Keep every block busy so each take() allocates fresh and the
+        # eviction branch (not refcount reuse) must enforce the cap.
+        busy = [pool.take(2048) for _ in range(3)]
+        assert sum(e.nbytes for e in pool._entries) <= 4096
+        # The newest (just-returned) block is never the eviction victim.
+        assert pool._entries[-1] is busy[-1].base
+        # Untracked busy blocks stay alive through their consumer views...
+        assert all((b == b).all() for b in busy)
+        evicted_ref = weakref.ref(busy[0].base)
+        del busy
+        # ...and are freed by GC once the views die.
+        assert evicted_ref() is None
+
+    def test_zero_cap_disables_pooling(self):
+        pool = sockio.BufferPool(max_bytes=0, min_size=16)
+        a = pool.take(1024)
+        assert pool._entries == []
+        assert a.nbytes == 1024
