@@ -130,6 +130,309 @@ def make_pp_loss_fn(
     return loss_fn
 
 
+def schedule_1f1b(n_stages: int, n_micro: int):
+    """Tick tables for the synchronous 1F1B schedule.
+
+    Each tick has one forward slot and one backward slot per stage.
+    ``F[t, s]``/``B[t, s]`` hold the microbatch index stage ``s`` processes
+    in that slot at tick ``t`` (-1 = idle). Derivation: forwards fill
+    GPipe-style, then interleave 1:1 with backwards
+    (``t_F = max(m+s, 2m+2s-(S-1))``); the last stage backs a microbatch
+    up the same tick it forwards it, and its gradient travels one
+    stage-hop per tick (``t_B = 2m + 2(S-1) - s``). In-flight activations
+    per stage stay bounded by stage depth (~1.5·(S-1-s)+1), independent
+    of the microbatch count — the 1F1B memory property.
+
+    Returns (F, B, R, ring): ``R[t, s]`` is the microbatch whose activation
+    arrives at stage ``s`` on tick ``t`` (the previous stage forwarded it
+    on tick ``t-1``; a warmup-stage producer can run several ticks ahead
+    of its consumer, so arrivals are stashed in the ring buffer rather
+    than consumed from the wire on the consuming tick). ``ring`` is the
+    buffer depth covering each microbatch's stash-to-backward lifetime.
+    """
+    import numpy as np
+
+    S, M = n_stages, n_micro
+    T = 2 * (M - 1) + 2 * (S - 1) + 1
+    F = np.full((T, S), -1, np.int32)
+    B = np.full((T, S), -1, np.int32)
+    for s in range(S):
+        for m in range(M):
+            tf = max(m + s, 2 * m + 2 * s - (S - 1))
+            tb = 2 * m + 2 * (S - 1) - s
+            assert F[tf, s] == -1 and B[tb, s] == -1, "slot double-booked"
+            assert tb >= tf, (tb, tf)
+            F[tf, s] = m
+            B[tb, s] = m
+    # A stage's input must have left the previous stage on an earlier tick.
+    for s in range(1, S):
+        for m in range(M):
+            tf_here = int(np.where(F[:, s] == m)[0][0])
+            tf_prev = int(np.where(F[:, s - 1] == m)[0][0])
+            assert tf_here > tf_prev, (s, m)
+    R = np.full((T, S), -1, np.int32)
+    R[1:, 1:] = F[:-1, :-1]
+    ring = 0
+    for s in range(S):
+        live = 0
+        for t in range(T):
+            # A slot is occupied from activation arrival (stage 0: its own
+            # forward) through the backward that consumes it.
+            if (R if s else F)[t, s] >= 0:
+                live += 1
+            ring = max(ring, live)
+            if B[t, s] >= 0:
+                live -= 1
+    return F, B, R, ring
+
+
+def make_1f1b_loss_and_grad(
+    cfg: tfm.TransformerConfig,
+    mesh: Mesh,
+    stage_axis: str = "stage",
+    n_microbatches: int = 4,
+    batch_axes: tuple = (),
+):
+    """Build ``fn(params, inputs, targets) -> (loss, grads)`` running the
+    1F1B pipeline schedule over ``mesh``'s ``stage_axis``.
+
+    Unlike the GPipe lane (one scan, autodiff derives the reverse
+    schedule — all forward activations in flight), the backward here is
+    hand-scheduled: each backward slot re-runs its stage from the stashed
+    stage *input* under ``jax.vjp`` (recompute-in-backward, exactly what
+    GPipe-with-remat pays) and the activation ring buffer holds only
+    O(n_stages) microbatches instead of all of them. Gradients therefore
+    come from this function directly — do not wrap it in ``jax.grad``.
+
+    ``batch_axes`` names mesh axes (party/data) the batch is sharded
+    over. They are handled *manually*: each device runs the schedule on
+    its local batch and loss/grads are psum-averaged at the end — the
+    psum-average over the party axis IS the federated aggregate. Any
+    remaining mesh axes (e.g. ``model``) stay GSPMD-automatic, so
+    Megatron-sharded stage params compose with this schedule the same
+    way they do with the GPipe lane.
+    """
+    n_stages = mesh.shape[stage_axis]
+    assert cfg.n_layers % n_stages == 0, (cfg.n_layers, n_stages)
+    batch_axes = tuple(a for a in batch_axes if a and mesh.shape.get(a, 1) > 1)
+    n_replicas = 1
+    for a in batch_axes:
+        n_replicas *= mesh.shape[a]
+    M = n_microbatches
+    F_np, B_np, R_np, ring = schedule_1f1b(n_stages, M)
+    T = F_np.shape[0]
+
+    def body(stages_local, embed, ln_f, lm_head, inputs, targets):
+        layers_local = jax.tree_util.tree_map(lambda x: x[0], stages_local)
+        s = lax.axis_index(stage_axis)
+        F_tab = jnp.asarray(F_np)
+        B_tab = jnp.asarray(B_np)
+        R_tab = jnp.asarray(R_np)
+        batch, seq = inputs.shape
+        assert batch % M == 0, (batch, M)
+        mb = batch // M
+        micro_in = inputs.reshape(M, mb, seq)
+        micro_tgt = targets.reshape(M, mb, seq)
+        positions = jnp.broadcast_to(jnp.arange(seq), (mb, seq))
+        cdt = cfg.compute_dtype
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [((i + 1) % n_stages, i) for i in range(n_stages)]
+        is_first = s == 0
+        is_last = s == n_stages - 1
+
+        def apply_stage(layers, h):
+            def one_layer(h, layer):
+                return tfm.layer_fn(h, layer, positions, cfg), None
+
+            h, _ = lax.scan(one_layer, h, layers)
+            return h
+
+        def last_stage_loss(layers, lnf, head, h, tgt):
+            x = tfm.rms_norm(apply_stage(layers, h), lnf)
+            logits = (x @ head.astype(cdt)).astype(jnp.float32)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, tgt[..., None], axis=-1
+            )[..., 0]
+            return (logz - gold).mean()
+
+        zeros_like_f32 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jnp.zeros(x.shape, jnp.float32), t
+        )
+
+        def tick(carry, t):
+            buf, h_msg, dh_msg, dlayers, dembed, dlnf, dhead, loss_acc = carry
+            fm = F_tab[t, s]
+            bm = B_tab[t, s]
+            rm = R_tab[t, s]
+            fm_c = jnp.clip(fm, 0, M - 1)
+            bm_c = jnp.clip(bm, 0, M - 1)
+            rm_c = jnp.clip(rm, 0, M - 1)
+
+            # ---- arrival: stash the activation the previous stage sent
+            # last tick (a warmup producer runs ahead of this consumer, so
+            # consumption happens from the ring, not straight off the wire).
+            buf = lax.cond(
+                rm >= 0,
+                lambda b: b.at[rm_c % ring].set(h_msg),
+                lambda b: b,
+                buf,
+            )
+
+            # ---- forward slot -------------------------------------------
+            def do_f(buf):
+                h_in = lax.cond(
+                    is_first,
+                    lambda: embed[micro_in[fm_c]].astype(cdt),
+                    lambda: buf[fm_c % ring],
+                )
+                h_out = apply_stage(layers_local, h_in)
+                # Stage 0 stashes its own input for the backward; others
+                # already hold it from the arrival stash.
+                buf = lax.cond(
+                    is_first,
+                    lambda b: b.at[fm_c % ring].set(h_in),
+                    lambda b: b,
+                    buf,
+                )
+                return buf, h_out
+
+            buf, h_out = lax.cond(
+                fm >= 0,
+                do_f,
+                lambda buf: (buf, jnp.zeros_like(h_msg)),
+                buf,
+            )
+
+            # ---- backward slot ------------------------------------------
+            h_saved = buf[bm_c % ring]
+
+            def do_b(args):
+                dlayers, dembed, dlnf, dhead, loss_acc = args
+
+                def b_last():
+                    loss_m, vjp = jax.vjp(
+                        last_stage_loss, layers_local, ln_f, lm_head,
+                        h_saved, micro_tgt[bm_c],
+                    )
+                    dl, dlnf_m, dhead_m, dh_in, _ = vjp(jnp.float32(1.0))
+                    return loss_m, dl, dlnf_m, dhead_m, dh_in
+
+                def b_mid():
+                    _, vjp = jax.vjp(apply_stage, layers_local, h_saved)
+                    dl, dh_in = vjp(dh_msg)
+                    return (
+                        jnp.float32(0.0), dl,
+                        jnp.zeros_like(ln_f), jnp.zeros_like(lm_head),
+                        dh_in,
+                    )
+
+                loss_m, dl, dlnf_m, dhead_m, dh_in = lax.cond(
+                    is_last, b_last, b_mid
+                )
+                dlayers = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), dlayers, dl
+                )
+                # Stage 0's input grad lands in the embedding table.
+                dembed = lax.cond(
+                    is_first,
+                    lambda: dembed.at[micro_in[bm_c]].add(
+                        dh_in.astype(jnp.float32)
+                    ),
+                    lambda: dembed,
+                )
+                return (
+                    (
+                        dlayers, dembed,
+                        dlnf + dlnf_m.astype(jnp.float32),
+                        dhead + dhead_m.astype(jnp.float32),
+                        loss_acc + loss_m,
+                    ),
+                    dh_in,
+                )
+
+            (dlayers, dembed, dlnf, dhead, loss_acc), dh_in = lax.cond(
+                bm >= 0,
+                do_b,
+                lambda args: (args, jnp.zeros_like(h_msg)),
+                (dlayers, dembed, dlnf, dhead, loss_acc),
+            )
+
+            # Hops ride every tick (collectives stay outside the conds);
+            # receivers gate on their own schedule slots.
+            h_next = lax.ppermute(h_out, stage_axis, fwd_perm)
+            dh_next = lax.ppermute(dh_in, stage_axis, bwd_perm)
+            return (
+                buf, h_next, dh_next, dlayers, dembed, dlnf, dhead, loss_acc
+            ), None
+
+        h0 = jnp.zeros((mb, seq, cfg.d_model), cdt)
+        carry0 = (
+            jnp.zeros((ring, mb, seq, cfg.d_model), cdt),
+            h0,
+            h0,
+            zeros_like_f32(layers_local),
+            jnp.zeros(embed.shape, jnp.float32),
+            jnp.zeros(ln_f.shape, jnp.float32),
+            jnp.zeros(lm_head.shape, jnp.float32),
+            jnp.float32(0.0),
+        )
+        carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+        _, _, _, dlayers, dembed, dlnf, dhead, loss_acc = carry
+        # Mean over microbatches, then over batch-axis replicas (party x
+        # data): the psum-average over party IS the federated aggregate.
+        inv = 1.0 / (M * n_replicas)
+        # Each stage owns its layer-grad slice; the replicated leaves were
+        # computed by one stage only (zeros elsewhere) -> psum publishes.
+        dlayers = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, batch_axes)[None] * inv
+            if batch_axes else g[None] * inv,
+            dlayers,
+        )
+        all_axes = (stage_axis,) + batch_axes
+        psum = lambda x: lax.psum(x, all_axes)  # noqa: E731
+        return (
+            psum(loss_acc) * inv,
+            dlayers,
+            psum(dembed) * inv,
+            psum(dlnf) * inv,
+            psum(dhead) * inv,
+        )
+
+    stage_spec = P(stage_axis)
+    rep = P()
+    batch_spec = P(batch_axes if batch_axes else None)
+    smapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_spec, rep, rep, rep, batch_spec, batch_spec),
+        out_specs=(rep, stage_spec, rep, rep, rep),
+        check_vma=False,
+        axis_names={stage_axis, *batch_axes},
+    )
+
+    def loss_and_grad(params, inputs, targets):
+        stages = stack_to_stages(params, n_stages)
+        loss, dstages, dembed, dlnf, dhead = smapped(
+            stages, params["embed"], params["ln_f"], params["lm_head"],
+            inputs, targets,
+        )
+        dlayers = jax.tree_util.tree_map(
+            lambda g: g.reshape((-1,) + g.shape[2:]), dstages
+        )
+        grads = {
+            "embed": dembed.astype(params["embed"].dtype),
+            "layers": jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), dlayers, params["layers"]
+            ),
+            "ln_f": dlnf.astype(params["ln_f"].dtype),
+            "lm_head": dhead.astype(params["lm_head"].dtype),
+        }
+        return loss, grads
+
+    return loss_and_grad
+
+
 def make_pp_train_step(
     cfg: tfm.TransformerConfig,
     mesh: Mesh,
@@ -139,6 +442,7 @@ def make_pp_train_step(
     data_axis=None,
     n_microbatches: int = 4,
     microbatch_group: int = 0,
+    schedule: str = "gpipe",
     lr: float = 3e-4,
 ):
     """Full pp(x tp)(x dp)(x party) training step in ONE jit over ``mesh``.
@@ -150,13 +454,15 @@ def make_pp_train_step(
     the party/data grad all-reduce doubles as the federated aggregate
     exactly as in :func:`rayfed_tpu.parallel.train.make_fed_train_step`.
 
-    ``microbatch_group`` > 0 runs the schedule in groups of that many
-    microbatches under a gradient-accumulation scan with the group body
-    rematerialized: in-flight activations are bounded by the group size
-    instead of the full microbatch count — the memory bound 1F1B provides
-    — at the cost of one pipeline fill/drain per group (the classic
-    schedule trade; a fused fwd/bwd interleave would cut the extra
-    bubbles too).
+    ``schedule`` picks the pipeline schedule:
+
+    - ``"gpipe"`` (default): one scan over ticks, autodiff derives the
+      reverse schedule. ``microbatch_group`` > 0 bounds in-flight
+      activations to the group size via a rematerialized
+      gradient-accumulation scan, paying one fill/drain per group.
+    - ``"1f1b"``: the hand-scheduled one-forward-one-backward interleave
+      (:func:`make_1f1b_loss_and_grad`) — in-flight activations bounded
+      by stage depth with a single fill/drain, no grouping needed.
     """
     import optax
 
@@ -164,17 +470,13 @@ def make_pp_train_step(
     from rayfed_tpu.parallel.train import make_optimizer
 
     optimizer = make_optimizer(lr)
-    groups = 1
-    per_group = n_microbatches
-    if microbatch_group:
-        assert n_microbatches % microbatch_group == 0, (
-            n_microbatches, microbatch_group,
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b'; got {schedule!r}")
+    if schedule == "1f1b" and microbatch_group:
+        raise ValueError(
+            "microbatch_group is a gpipe-schedule knob; 1f1b already bounds "
+            "in-flight activations by stage depth"
         )
-        groups = n_microbatches // microbatch_group
-        per_group = microbatch_group
-    group_loss = make_pp_loss_fn(
-        cfg, mesh, stage_axis=stage_axis, n_microbatches=per_group
-    )
 
     batch_axes = tuple(
         a for a in (party_axis, data_axis) if a and mesh.shape.get(a, 1) > 1
@@ -182,24 +484,49 @@ def make_pp_train_step(
     batch_pspec = P(batch_axes if batch_axes else None)
     batch_sharding = NamedSharding(mesh, batch_pspec)
 
-    def loss_fn(params, inputs, targets):
-        if groups == 1:
-            return group_loss(params, inputs, targets)
-        b = inputs.shape[0]
-        assert b % groups == 0, (b, groups)
-        gi = inputs.reshape(groups, b // groups, -1)
-        gt = targets.reshape(groups, b // groups, -1)
+    if schedule == "1f1b":
+        loss_grad_fn = make_1f1b_loss_and_grad(
+            cfg, mesh, stage_axis=stage_axis, n_microbatches=n_microbatches,
+            batch_axes=(party_axis, data_axis),
+        )
+        loss_fn = None
+    else:
+        loss_grad_fn = None
+        groups = 1
+        per_group = n_microbatches
+        if microbatch_group:
+            assert n_microbatches % microbatch_group == 0, (
+                n_microbatches, microbatch_group,
+            )
+            groups = n_microbatches // microbatch_group
+            per_group = microbatch_group
+        group_loss = make_pp_loss_fn(
+            cfg, mesh, stage_axis=stage_axis, n_microbatches=per_group
+        )
 
-        def acc(total, xs):
-            i, t = xs
-            return total + group_loss(params, i, t), None
+        def loss_fn(params, inputs, targets):
+            if groups == 1:
+                return group_loss(params, inputs, targets)
+            b = inputs.shape[0]
+            assert b % groups == 0, (b, groups)
+            gi = inputs.reshape(groups, b // groups, -1)
+            gt = targets.reshape(groups, b // groups, -1)
 
-        body = jax.checkpoint(acc, prevent_cse=False)
-        total, _ = lax.scan(body, jnp.float32(0.0), (gi, gt))
-        return total / groups
+            def acc(total, xs):
+                i, t = xs
+                return total + group_loss(params, i, t), None
+
+            body = jax.checkpoint(acc, prevent_cse=False)
+            total, _ = lax.scan(body, jnp.float32(0.0), (gi, gt))
+            return total / groups
 
     def step(params, opt_state, inputs, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets)
+        if loss_grad_fn is not None:
+            loss, grads = loss_grad_fn(params, inputs, targets)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, inputs, targets
+            )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -166,6 +166,27 @@ class BufferPool:
         del evicted  # munmap of evicted blocks happens after lock release
         return block[:]
 
+    def trim(self) -> None:
+        """Drop every currently-free block (busy blocks stay tracked).
+
+        Transports call this at shutdown so a burst of large frames does
+        not pin pool memory for the rest of the process's life."""
+        dropped = []
+        keep = []
+        with self._lock:
+            for block in self._entries:
+                # refs at the check: list slot + loop var + getrefcount
+                # arg = 3 for a free block; consumer views add more.
+                (keep if sys.getrefcount(block) > 3 else dropped).append(block)
+            self._entries = keep
+            self._total = sum(b.nbytes for b in keep)
+        del dropped  # frees outside the lock
+
+
+def trim_recv_pool() -> None:
+    """Release the module pool's free blocks (called on transport stop)."""
+    _RECV_POOL.trim()
+
 
 def _pool_max_bytes() -> int:
     mb = os.environ.get("FEDTPU_RECV_POOL_MB")

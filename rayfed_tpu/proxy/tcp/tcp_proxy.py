@@ -424,6 +424,8 @@ class TcpReceiverProxy(ReceiverProxy):
             except OSError:
                 pass
         self._store.shutdown()
+        # A burst of large frames must not pin pool memory past the job.
+        sockio.trim_recv_pool()
 
     # -- data path -------------------------------------------------------------
 

@@ -159,3 +159,17 @@ class TestBufferPool:
         a = pool.take(1024)
         assert pool._entries == []
         assert a.nbytes == 1024
+
+    def test_trim_drops_free_keeps_busy(self):
+        import weakref
+
+        pool = sockio.BufferPool(max_bytes=1 << 30, min_size=16)
+        busy = pool.take(1024)
+        free = pool.take(1024)
+        free_ref = weakref.ref(free.base)
+        del free
+        pool.trim()
+        assert free_ref() is None  # free block dropped
+        assert len(pool._entries) == 1  # busy block still tracked
+        assert (busy == busy).all()
+        assert pool._total == pool._entries[0].nbytes
