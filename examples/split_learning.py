@@ -85,12 +85,16 @@ def main():
     )
     bottom = Bottom.party("alice").remote()
     head = Head.party("bob").remote()
+    first = last = None
     for step in range(STEPS):
         h = bottom.forward.remote()
         grad_h = head.step.remote(h)
         bottom.backward.remote(grad_h)
         loss = fed.get(head.get_loss.remote())
         print(f"[{party}] step {step}: loss {loss:.5f}")
+        first = loss if first is None else first
+        last = loss
+    assert last < first, f"loss did not decrease: {first} -> {last}"
     fed.shutdown()
 
 

@@ -45,7 +45,7 @@ def get_addresses(parties: List[str]) -> Dict[str, str]:
 def run_parties(
     target: Callable,
     parties: List[str],
-    timeout: float = 120,
+    timeout: float = 240,  # generous: 1-core CI hosts stall under compile load
     extra_args: tuple = (),
     addresses: Optional[Dict[str, str]] = None,
 ) -> None:
