@@ -1,0 +1,129 @@
+"""Hand-rolled protobuf codec for the reference's wire messages.
+
+The reference's gRPC service speaks two flat proto3 messages over
+``/GrpcService/SendData`` (ref ``fed/grpc/fed.proto:5-19``):
+
+    SendDataRequest  { bytes data = 1; string upstream_seq_id = 2;
+                       string downstream_seq_id = 3; string job_name = 4; }
+    SendDataResponse { int32 code = 1; string result = 2; }
+
+Both use only length-delimited fields plus one varint — ~60 lines of
+wire-format code, so this lane is byte-compatible with reference peers
+without a protoc codegen step (pinned against ``protoc --encode`` in
+``tests/test_fedproto.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+_LEN = 2  # wire type: length-delimited
+_VARINT = 0
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        # proto3 int32: negatives go as 64-bit two's complement (10 bytes).
+        n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire_type: int) -> bytes:
+    return _varint((field << 3) | wire_type)
+
+
+def _len_field(field: int, data: bytes) -> bytes:
+    return _tag(field, _LEN) + _varint(len(data)) + data if data else b""
+
+
+def _read_varint(buf, pos: int) -> Tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        if pos >= len(buf):
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _parse(buf) -> dict:
+    """Parse a message into {field_number: last_value}; unknown fields and
+    wire types are skipped (proto3 semantics)."""
+    fields: dict = {}
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == _VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wt == _LEN:
+            n, pos = _read_varint(buf, pos)
+            if pos + n > end:
+                raise ValueError("truncated length-delimited field")
+            val = bytes(buf[pos: pos + n])
+            pos += n
+        elif wt == 1:  # 64-bit, skip
+            val = None
+            pos += 8
+            if pos > end:
+                raise ValueError("truncated 64-bit field")
+        elif wt == 5:  # 32-bit, skip
+            val = None
+            pos += 4
+            if pos > end:
+                raise ValueError("truncated 32-bit field")
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        if val is not None:
+            fields[field] = val
+    return fields
+
+
+def encode_send_data_request(data: bytes, upstream_seq_id: str,
+                             downstream_seq_id: str, job_name: str) -> bytes:
+    return (
+        _len_field(1, bytes(data))
+        + _len_field(2, str(upstream_seq_id).encode())
+        + _len_field(3, str(downstream_seq_id).encode())
+        + _len_field(4, str(job_name).encode())
+    )
+
+
+def decode_send_data_request(buf) -> Tuple[bytes, str, str, str]:
+    f = _parse(buf)
+    return (
+        f.get(1, b""),
+        f.get(2, b"").decode(),
+        f.get(3, b"").decode(),
+        f.get(4, b"").decode(),
+    )
+
+
+def encode_send_data_response(code: int, result: str) -> bytes:
+    out = b""
+    if code:
+        out += _tag(1, _VARINT) + _varint(code)
+    return out + _len_field(2, str(result).encode())
+
+
+def decode_send_data_response(buf) -> Tuple[int, str]:
+    f = _parse(buf)
+    code = int(f.get(1, 0)) & 0xFFFFFFFF  # int32 view of the varint
+    if code >= 1 << 31:
+        code -= 1 << 32
+    return code, f.get(2, b"").decode()

@@ -1,18 +1,24 @@
-"""Reference-parity gRPC transport.
+"""Reference-compatible gRPC transport.
 
-This is the measurement-baseline lane (SURVEY.md §7 stage 2): it reproduces
-the reference's wire behavior — one unary RPC per object with the payload
-**cloudpickled** inside the request (ref ``fed/proxy/grpc/grpc_proxy.py:
-193-220``), gRPC channel-level retry policy (ref ``grpc_options.py:19-46``),
-500 MB default message caps, job-name 417 isolation, and mutual TLS — so
-``bench.py`` can compare the native TCP/TPU data plane against exactly what
-the reference does.
+This lane is both the measurement baseline (SURVEY.md §7 stage 2) and
+wire-interoperable with reference peers: one unary RPC per object with
+the payload **cloudpickled** inside a protobuf ``SendDataRequest`` on
+``/GrpcService/SendData`` — the reference's exact method path and message
+schema (ref ``fed/grpc/fed.proto:5-19``, ``fed/proxy/grpc/grpc_proxy.py:
+193-220``) — plus gRPC channel-level retry policy (ref
+``grpc_options.py:19-46``), 500 MB default message caps, job-name 417
+isolation, and mutual TLS. ``bench.py`` compares the native TCP/TPU data
+plane against exactly what the reference does on the wire.
 
-Implementation note: rather than generated protobuf stubs, the single
-``SendData`` method uses raw-bytes (de)serializers with a msgpack header —
-wire-equivalent framing without codegen. Everything above the channel is the
-reference's shape: sender reuses one channel per destination, receiver
-parks payloads in the shared rendezvous store.
+Implementation note: the two flat messages are coded by
+:mod:`rayfed_tpu.proxy.grpc.fedproto` (hand-rolled wire format pinned
+against ``protoc --encode``) rather than generated stubs — no codegen
+step. Everything above the channel is the reference's shape: sender
+reuses one channel per destination, receiver parks payloads in the
+shared rendezvous store. The reference wire carries no ``is_error`` flag
+(error envelopes are ordinary pickled payloads), so the strict
+arrays-only mode cannot admit them on this lane — use the native
+transports when ``allow_pickle_payloads=False``.
 """
 
 from __future__ import annotations
@@ -24,7 +30,6 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
 import grpc
-import msgpack
 
 import cloudpickle
 from rayfed_tpu._private.constants import CODE_OK
@@ -32,11 +37,14 @@ from rayfed_tpu._private.serialization import restricted_loads
 from rayfed_tpu.config import TcpCrossSiloMessageConfig
 from rayfed_tpu.exceptions import FedLocalError
 from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
+from rayfed_tpu.proxy.grpc import fedproto
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
 
 logger = logging.getLogger(__name__)
 
-_SERVICE = "rayfed_tpu.GrpcService"
+# The reference's proto has no package, so the method path is
+# /GrpcService/SendData (ref fed/grpc/fed.proto:5-7).
+_SERVICE = "GrpcService"
 _SEND_DATA = "SendData"
 _METHOD_PATH = f"/{_SERVICE}/{_SEND_DATA}"
 
@@ -82,18 +90,6 @@ def _load_tls_files(tls_config: Dict):
     return ca, cert, key
 
 
-def _pack_request(job_name, src_party, upstream_seq_id, downstream_seq_id,
-                  is_error, payload: bytes) -> bytes:
-    header = {
-        "job": job_name,
-        "src": src_party,
-        "up": str(upstream_seq_id),
-        "down": str(downstream_seq_id),
-        "is_error": bool(is_error),
-        "pkind": "pickle",
-        "pmeta": b"",
-    }
-    return msgpack.packb({"h": header, "d": payload}, use_bin_type=True)
 
 
 class GrpcSenderProxy(SenderProxy):
@@ -159,9 +155,10 @@ class GrpcSenderProxy(SenderProxy):
         # transports avoid.
         t0 = time.perf_counter()
         blob = cloudpickle.dumps(data)
-        request = _pack_request(
-            self._job_name, self._party, upstream_seq_id, downstream_seq_id,
-            is_error, blob,
+        # The reference wire has no is_error field — an error envelope is
+        # just another pickled payload (ref cleanup.py:160-172).
+        request = fedproto.encode_send_data_request(
+            blob, upstream_seq_id, downstream_seq_id, self._job_name
         )
         stub = self._get_channel(dest_party).unary_unary(
             _METHOD_PATH,
@@ -173,8 +170,8 @@ class GrpcSenderProxy(SenderProxy):
             resp_bytes = stub(
                 request, timeout=self._config.timeout_in_ms / 1000
             )
-            resp = msgpack.unpackb(resp_bytes, raw=False)
-            ok = resp["code"] == CODE_OK
+            code, result = fedproto.decode_send_data_response(resp_bytes)
+            ok = code == CODE_OK
         finally:
             tracing.record(
                 "send", dest_party, upstream_seq_id, downstream_seq_id,
@@ -185,9 +182,9 @@ class GrpcSenderProxy(SenderProxy):
         if ok:
             return True
         logger.warning(
-            "peer rejected send: code=%s message=%s", resp["code"], resp["msg"]
+            "peer rejected send: code=%s message=%s", code, result
         )
-        raise RuntimeError(f"send rejected: code={resp['code']} {resp['msg']}")
+        raise RuntimeError(f"send rejected: code={code} {result}")
 
 
 class GrpcReceiverProxy(ReceiverProxy):
@@ -213,9 +210,18 @@ class GrpcReceiverProxy(ReceiverProxy):
         store = self._store
 
         def handle_send_data(request: bytes, context) -> bytes:
-            msg = msgpack.unpackb(request, raw=False)
-            code, text = store.offer(msg["h"], memoryview(msg["d"]))
-            return msgpack.packb({"code": code, "msg": text}, use_bin_type=True)
+            data, up, down, job = fedproto.decode_send_data_request(request)
+            header = {
+                "job": job,
+                "src": "",  # not carried by the reference wire
+                "up": up,
+                "down": down,
+                "is_error": False,
+                "pkind": "pickle",
+                "pmeta": b"",
+            }
+            code, text = store.offer(header, memoryview(data))
+            return fedproto.encode_send_data_response(code, text)
 
         handler = grpc.method_handlers_generic_handler(
             _SERVICE,

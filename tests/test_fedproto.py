@@ -1,0 +1,102 @@
+"""Wire-format tests for the hand-rolled reference protobuf codec.
+
+The cross-check pins our bytes against ``protoc --encode`` on a proto
+file carrying the reference's message schema (ref ``fed/grpc/fed.proto``),
+so the gRPC lane stays byte-compatible with reference peers.
+"""
+
+import shutil
+import subprocess
+
+import pytest
+
+from rayfed_tpu.proxy.grpc import fedproto
+
+PROTO_SRC = """syntax = "proto3";
+message SendDataRequest {
+    bytes data = 1;
+    string upstream_seq_id = 2;
+    string downstream_seq_id = 3;
+    string job_name = 4;
+}
+message SendDataResponse {
+    int32 code = 1;
+    string result = 2;
+}
+"""
+
+
+def test_request_roundtrip():
+    req = fedproto.encode_send_data_request(
+        b"\x00\x01payload", "12#0", "34", "job-x"
+    )
+    data, up, down, job = fedproto.decode_send_data_request(req)
+    assert data == b"\x00\x01payload"
+    assert (up, down, job) == ("12#0", "34", "job-x")
+
+
+def test_response_roundtrip():
+    for code, result in [(200, "ok"), (417, "job mismatch"), (0, "")]:
+        buf = fedproto.encode_send_data_response(code, result)
+        assert fedproto.decode_send_data_response(buf) == (code, result)
+
+
+def test_unknown_fields_are_skipped():
+    # A future peer may add fields; decoding must not break.
+    extra = fedproto._tag(9, 2) + fedproto._varint(3) + b"xyz"
+    extra += fedproto._tag(10, 0) + fedproto._varint(7)
+    req = fedproto.encode_send_data_request(b"d", "1", "2", "j") + extra
+    assert fedproto.decode_send_data_request(req)[0] == b"d"
+
+
+def test_truncated_rejected():
+    req = fedproto.encode_send_data_request(b"data", "1", "2", "j")
+    with pytest.raises(ValueError):
+        fedproto._parse(req[:-2])
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc missing")
+def test_bytes_match_protoc(tmp_path):
+    proto = tmp_path / "fed_wire.proto"
+    proto.write_text(PROTO_SRC)
+
+    def protoc_encode(message: str, textformat: str) -> bytes:
+        return subprocess.run(
+            ["protoc", f"--proto_path={tmp_path}",
+             f"--encode={message}", "fed_wire.proto"],
+            input=textformat.encode(), capture_output=True, check=True,
+        ).stdout
+
+    golden_req = protoc_encode(
+        "SendDataRequest",
+        'data: "abc\\x00def" upstream_seq_id: "11#1" '
+        'downstream_seq_id: "42" job_name: "demo"',
+    )
+    ours = fedproto.encode_send_data_request(
+        b"abc\x00def", "11#1", "42", "demo"
+    )
+    assert ours == golden_req
+
+    golden_resp = protoc_encode(
+        "SendDataResponse", 'code: 417 result: "job name mismatch"'
+    )
+    assert fedproto.encode_send_data_response(
+        417, "job name mismatch"
+    ) == golden_resp
+    # And decode protoc's bytes back.
+    assert fedproto.decode_send_data_response(golden_resp) == (
+        417, "job name mismatch",
+    )
+
+
+@pytest.mark.skipif(shutil.which("protoc") is None, reason="protoc missing")
+def test_negative_int32_matches_protoc(tmp_path):
+    proto = tmp_path / "fed_wire.proto"
+    proto.write_text(PROTO_SRC)
+    golden = subprocess.run(
+        ["protoc", f"--proto_path={tmp_path}",
+         "--encode=SendDataResponse", "fed_wire.proto"],
+        input=b'code: -1 result: "neg"', capture_output=True, check=True,
+    ).stdout
+    assert fedproto.encode_send_data_response(-1, "neg") == golden
+    assert fedproto.decode_send_data_response(golden) == (-1, "neg")
