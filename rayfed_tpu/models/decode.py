@@ -1,0 +1,157 @@
+"""KV-cache autoregressive decoding for the flagship transformer.
+
+The reference engine never runs models, so inference is pure new surface
+for this framework: party-local generation on whatever checkpoint a
+federated job just trained (e.g. sample from the aggregated model after a
+FedAvg round, or serve the label party's head in split learning).
+
+TPU-first design:
+ - the K/V cache is **stacked over layers** — (n_layers, B, T, H, Dh) —
+   mirroring the stacked layer parameters, so one ``lax.scan`` over layers
+   threads (x, cache) through a single compiled block body;
+ - the decode loop is a ``lax.scan`` over steps with static lengths: one
+   compile for the whole generation, no per-token retrace, cache updates
+   via ``lax.dynamic_update_slice_in_dim`` (in-place on TPU thanks to
+   donation inside the scan carry);
+ - prefill and decode share one cached-block implementation (prefill is
+   just the S>1 case at offset 0), and the projections/FFN come from
+   :mod:`rayfed_tpu.models.transformer` so the numerics match training
+   bit-for-bit at equal dtypes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from rayfed_tpu.models import transformer as tfm
+
+Cache = dict
+
+
+def init_cache(
+    cfg: tfm.TransformerConfig, batch: int, max_len: int, dtype=None
+) -> Cache:
+    """Zero-filled K/V cache covering ``max_len`` total positions."""
+    dtype = dtype or cfg.compute_dtype
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def forward_with_cache(
+    params, tokens, cache: Cache, offset, cfg: tfm.TransformerConfig
+):
+    """Run ``tokens`` (B, S) int32 starting at global position ``offset``
+    (S=1 while decoding, S=prompt length during prefill), reading and
+    updating ``cache``. Returns (logits (B, S, vocab) f32, new_cache).
+
+    The stacked (L, B, T, H, Dh) cache rides the **carry** of the layer
+    scan: each layer writes only its (B, S, H, Dh) slice via
+    ``dynamic_update_slice``, so XLA updates the donated carry buffer in
+    place — per-step cache traffic is one slab read (the attention) plus
+    one slice write, not a rewrite of the whole stack. Cache slots past
+    ``offset + S`` hold zeros; the causal mask in
+    :func:`transformer.causal_attention` (q_pos >= k_pos) never attends
+    to them.
+    """
+    b, s = tokens.shape
+    max_len = cache["k"].shape[2]
+    # dynamic_update_slice would silently CLAMP an out-of-range start index
+    # (misplacing K/V and corrupting logits); fail loudly where the bound
+    # is checkable — s is always static, offset whenever passed concrete.
+    if s > max_len:
+        raise ValueError(f"token block ({s}) longer than cache ({max_len})")
+    if not isinstance(offset, jax.core.Tracer) and int(offset) + s > max_len:
+        raise ValueError(
+            f"cache overflow: offset {int(offset)} + block {s} > {max_len}"
+        )
+    x = params["embed"][tokens].astype(cfg.compute_dtype)
+    positions = jnp.broadcast_to(offset + jnp.arange(s)[None, :], (b, s))
+    cdt = cfg.compute_dtype
+
+    def body(carry, layer):
+        x, ck, cv, i = carry
+        q, k, v = tfm.qkv_proj(x, layer, positions, cfg)
+        at = (i, 0, offset, 0, 0)
+        ck = jax.lax.dynamic_update_slice(ck, k[None].astype(ck.dtype), at)
+        cv = jax.lax.dynamic_update_slice(cv, v[None].astype(cv.dtype), at)
+        o = tfm.causal_attention(
+            q,
+            jax.lax.dynamic_index_in_dim(ck, i, axis=0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(cv, i, axis=0, keepdims=False),
+            q_offset=offset,
+        )
+        x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
+        hmlp = tfm.rms_norm(x, layer["ln2"])
+        x = x + tfm.ffn_apply(hmlp, layer, cfg)
+        return (x, ck, cv, i + 1), None
+
+    init = (x, cache["k"], cache["v"], jnp.asarray(0, jnp.int32))
+    (x, ck, cv, _), _ = jax.lax.scan(body, init, params["layers"])
+    x = tfm.rms_norm(x, params["ln_f"])
+    logits = (x @ params["lm_head"].astype(cfg.compute_dtype)).astype(
+        jnp.float32
+    )
+    return logits, {"k": ck, "v": cv}
+
+
+def prefill(params, prompt, cache: Cache, cfg: tfm.TransformerConfig):
+    """Fill the cache from a (B, S) prompt; returns (last-position logits
+    (B, vocab), cache)."""
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    return logits[:, -1], cache
+
+
+def make_generate_fn(
+    cfg: tfm.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    jit: bool = True,
+):
+    """Build ``generate(params, prompt, rng=None) -> (B, S+max_new)``.
+
+    Greedy when ``temperature == 0`` (rng unused), otherwise softmax
+    sampling at the given temperature. Lengths are static: the returned
+    function compiles once per prompt shape.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+
+    def sample(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    def generate(params, prompt, rng: Optional[jax.Array] = None):
+        b, s = prompt.shape
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        # The cache only ever holds tokens that later tokens attend to, so
+        # the final sampled token needs no slot (and no forward pass).
+        cache = init_cache(cfg, b, s + max_new_tokens - 1)
+        last_logits, cache = prefill(params, prompt, cache, cfg)
+        rng, sub = jax.random.split(rng)
+        first = sample(last_logits, sub).astype(prompt.dtype)
+
+        def step(carry, _):
+            tok, cache, pos, key = carry
+            logits, cache = forward_with_cache(
+                params, tok[:, None], cache, pos, cfg
+            )
+            key, sub = jax.random.split(key)
+            nxt = sample(logits[:, -1], sub).astype(prompt.dtype)
+            return (nxt, cache, pos + 1, key), nxt
+
+        _, toks = jax.lax.scan(
+            step,
+            (first, cache, jnp.asarray(s, jnp.int32), rng),
+            None,
+            length=max_new_tokens - 1,
+        )
+        new = jnp.concatenate([first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
+        return jnp.concatenate([prompt, new], axis=1)
+
+    return jax.jit(generate) if jit else generate

@@ -161,35 +161,48 @@ def causal_attention(q, k, v, q_offset=None):
 AttnFn = Callable[..., jax.Array]
 
 
-def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
-             attn_fn: Optional[AttnFn] = None):
-    """One pre-norm decoder block; ``attn_fn(q, k, v)`` is pluggable so
-    sequence-parallel callers can swap in ring attention."""
-    attn_fn = attn_fn or causal_attention
+def qkv_proj(x, layer: Params, positions, cfg: TransformerConfig):
+    """Pre-norm + Q/K/V projections + RoPE for one block; shared by the
+    training forward and the KV-cache decode path (models/decode.py)."""
     cdt = cfg.compute_dtype
     h = rms_norm(x, layer["ln1"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(cdt))
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(cdt))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(cdt))
     q, k = rope(q, k, positions, cfg.rope_theta)
-    # Named for selective rematerialization: saving each layer's attention
-    # output (B*S*D, the cheapest-to-keep/most-expensive-to-recompute
-    # tensor) lets the remat backward skip re-running the attention kernel.
-    o = checkpoint_name(attn_fn(q, k, v), "attn_out")
-    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
-    hmlp = rms_norm(x, layer["ln2"])
+    return q, k, v
+
+
+def ffn_apply(hmlp, layer: Params, cfg: TransformerConfig):
+    """The block's FFN half on a pre-normed input: SwiGLU, or the MoE FFN
+    when ``cfg.n_experts > 0``."""
+    cdt = cfg.compute_dtype
     if cfg.n_experts > 0:
         from rayfed_tpu.models.moe import moe_ffn_apply
 
         moe = jax.tree_util.tree_map(
             lambda p: p.astype(cdt), layer["moe"]
         )
-        x = x + moe_ffn_apply(moe, hmlp)
-    else:
-        gate = jax.nn.silu(hmlp @ layer["w_gate"].astype(cdt))
-        up = hmlp @ layer["w_up"].astype(cdt)
-        x = x + (gate * up) @ layer["w_down"].astype(cdt)
-    return x
+        return moe_ffn_apply(moe, hmlp)
+    gate = jax.nn.silu(hmlp @ layer["w_gate"].astype(cdt))
+    up = hmlp @ layer["w_up"].astype(cdt)
+    return (gate * up) @ layer["w_down"].astype(cdt)
+
+
+def layer_fn(x, layer: Params, positions, cfg: TransformerConfig,
+             attn_fn: Optional[AttnFn] = None):
+    """One pre-norm decoder block; ``attn_fn(q, k, v)`` is pluggable so
+    sequence-parallel callers can swap in ring attention."""
+    attn_fn = attn_fn or causal_attention
+    cdt = cfg.compute_dtype
+    q, k, v = qkv_proj(x, layer, positions, cfg)
+    # Named for selective rematerialization: saving each layer's attention
+    # output (B*S*D, the cheapest-to-keep/most-expensive-to-recompute
+    # tensor) lets the remat backward skip re-running the attention kernel.
+    o = checkpoint_name(attn_fn(q, k, v), "attn_out")
+    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(cdt))
+    hmlp = rms_norm(x, layer["ln2"])
+    return x + ffn_apply(hmlp, layer, cfg)
 
 
 def hidden_states(params: Params, tokens, cfg: TransformerConfig,

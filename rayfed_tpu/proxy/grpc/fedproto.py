@@ -15,7 +15,7 @@ without a protoc codegen step (pinned against ``protoc --encode`` in
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Tuple, Union
 
 _LEN = 2  # wire type: length-delimited
 _VARINT = 0
@@ -75,7 +75,10 @@ def _parse(buf) -> dict:
             n, pos = _read_varint(buf, pos)
             if pos + n > end:
                 raise ValueError("truncated length-delimited field")
-            val = bytes(buf[pos: pos + n])
+            # Zero-copy view into the request buffer: the payload field can
+            # be 100MB+, and every consumer accepts a memoryview (string
+            # fields are bytes()-ed at the decode_* sites).
+            val = memoryview(buf)[pos: pos + n]
             pos += n
         elif wt == 1:  # 64-bit, skip
             val = None
@@ -96,21 +99,34 @@ def _parse(buf) -> dict:
 
 def encode_send_data_request(data: bytes, upstream_seq_id: str,
                              downstream_seq_id: str, job_name: str) -> bytes:
-    return (
-        _len_field(1, bytes(data))
-        + _len_field(2, str(upstream_seq_id).encode())
-        + _len_field(3, str(downstream_seq_id).encode())
-        + _len_field(4, str(job_name).encode())
-    )
+    # Single-copy assembly: the payload blob can be 100MB+, so collect the
+    # pieces and join once instead of left-associative `+` (which would
+    # re-copy the blob prefix for every appended field).
+    parts = []
+    data = bytes(data)
+    if data:
+        parts += [_tag(1, _LEN), _varint(len(data)), data]
+    for field, value in (
+        (2, upstream_seq_id), (3, downstream_seq_id), (4, job_name)
+    ):
+        enc = str(value).encode()
+        if enc:
+            parts += [_tag(field, _LEN), _varint(len(enc)), enc]
+    return b"".join(parts)
 
 
-def decode_send_data_request(buf) -> Tuple[bytes, str, str, str]:
+def decode_send_data_request(buf) -> Tuple[Union[bytes, memoryview], str, str, str]:
+    """Returns (payload, upstream_seq_id, downstream_seq_id, job_name).
+
+    The payload is a zero-copy ``memoryview`` into ``buf`` when present
+    (``b""`` when absent) — callers needing ``bytes`` semantics must wrap
+    it themselves; it keeps ``buf`` alive while referenced."""
     f = _parse(buf)
     return (
         f.get(1, b""),
-        f.get(2, b"").decode(),
-        f.get(3, b"").decode(),
-        f.get(4, b"").decode(),
+        bytes(f.get(2, b"")).decode(),
+        bytes(f.get(3, b"")).decode(),
+        bytes(f.get(4, b"")).decode(),
     )
 
 
@@ -126,4 +142,4 @@ def decode_send_data_response(buf) -> Tuple[int, str]:
     code = int(f.get(1, 0)) & 0xFFFFFFFF  # int32 view of the varint
     if code >= 1 << 31:
         code -= 1 << 32
-    return code, f.get(2, b"").decode()
+    return code, bytes(f.get(2, b"")).decode()

@@ -90,8 +90,6 @@ def _load_tls_files(tls_config: Dict):
     return ca, cert, key
 
 
-
-
 class GrpcSenderProxy(SenderProxy):
     def __init__(self, addresses, party, job_name, tls_config, proxy_config=None):
         super().__init__(addresses, party, job_name, tls_config, proxy_config)

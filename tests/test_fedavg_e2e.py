@@ -109,3 +109,88 @@ def test_two_party_fedavg_logreg(tmp_path):
         p: (tmp_path / f"{p}.digest").read_text() for p in ["alice", "bob"]
     }
     assert digests["alice"] == digests["bob"], digests
+
+
+def run_fedavg_cnn(party, addresses, digest_dir):
+    """Federated CNN training on per-party image shards (BASELINE config
+    #5 at reduced shapes) through the high-level FedAvgTrainer with
+    sample-count weighting — the examples/fedavg_cnn.py pattern."""
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(FAST_COMM_CONFIG)},
+    )
+
+    shard = {"alice": 96, "bob": 64}
+    classes, batch = 10, 32
+
+    @fed.remote
+    class CnnWorker:
+        def __init__(self, party, seed):
+            import jax
+
+            from rayfed_tpu.models.cnn import cnn_loss, init_cnn
+
+            self.params = init_cnn(
+                jax.random.PRNGKey(0), num_classes=classes,
+                channels=(8, 16), input_hw=16,
+            )
+            rng = np.random.default_rng(seed)
+            n = shard[party]
+            self.x = rng.normal(size=(n, 16, 16, 3)).astype(np.float32)
+            self.y = rng.integers(0, classes, size=(n,))
+
+            def step(params, x, y):
+                loss, grads = jax.value_and_grad(cnn_loss)(params, x, y)
+                return jax.tree_util.tree_map(
+                    lambda p, g: p - 0.05 * g, params, grads
+                ), loss
+
+            self._step = jax.jit(step)
+
+        def train(self, global_params):
+            if global_params is not None:
+                self.params = global_params
+            self.params, loss = self._step(
+                self.params, self.x[:batch], self.y[:batch]
+            )
+            self._last = float(loss)
+            return self.params
+
+        def loss(self):
+            return self._last
+
+    from rayfed_tpu.federated import FedAvgTrainer
+
+    trainer = FedAvgTrainer(
+        CnnWorker, ["alice", "bob"],
+        worker_args={"alice": ("alice", 1), "bob": ("bob", 2)},
+        op="wmean",
+        weights={p: float(n) for p, n in shard.items()},
+    )
+    final = fed.get(trainer.run(2))
+    assert np.isfinite(fed.get(trainer.workers[party].loss.remote()))
+    fed.shutdown()
+
+    import hashlib
+    import pathlib
+
+    digest = b"".join(
+        np.asarray(leaf).tobytes()
+        for leaf in __import__("jax").tree_util.tree_leaves(final)
+    )
+    h = hashlib.sha256(digest).hexdigest()
+    pathlib.Path(digest_dir, f"{party}.cnn.digest").write_text(h)
+
+
+def test_two_party_fedavg_cnn(tmp_path):
+    run_parties(
+        run_fedavg_cnn,
+        ["alice", "bob"],
+        extra_args=(str(tmp_path),),
+        timeout=240,
+    )
+    digests = {
+        p: (tmp_path / f"{p}.cnn.digest").read_text() for p in ["alice", "bob"]
+    }
+    assert digests["alice"] == digests["bob"], digests
