@@ -25,10 +25,28 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rayfed_tpu.models import transformer as tfm
 
 Cache = dict
+
+
+def cache_spec(
+    mesh: Mesh,
+    party_axis: Optional[str] = "party",
+    data_axis: Optional[str] = "data",
+    model_axis: Optional[str] = "model",
+) -> P:
+    """PartitionSpec for the stacked (L, B, T, H, Dh) K/V cache: batch over
+    party x data, heads over the tensor-parallel axis — the same layout the
+    Megatron rules give the attention activations, so cached decode runs
+    with zero resharding against tp-sharded parameters."""
+    from rayfed_tpu.parallel import sharding as shd
+
+    batch = shd.batch_spec(mesh, party_axis, data_axis)[0]
+    heads = model_axis if model_axis in mesh.axis_names else None
+    return P(None, batch, None, heads, None)
 
 
 def init_cache(
@@ -110,15 +128,30 @@ def make_generate_fn(
     max_new_tokens: int,
     temperature: float = 0.0,
     jit: bool = True,
+    mesh: Optional[Mesh] = None,
+    party_axis: Optional[str] = "party",
+    data_axis: Optional[str] = "data",
 ):
     """Build ``generate(params, prompt, rng=None) -> (B, S+max_new)``.
 
     Greedy when ``temperature == 0`` (rng unused), otherwise softmax
     sampling at the given temperature. Lengths are static: the returned
     function compiles once per prompt shape.
+
+    With ``mesh``, decoding runs sharded: params follow the Megatron tp
+    rules (:mod:`rayfed_tpu.parallel.sharding`), the prompt/batch shards
+    over party x data, and the K/V cache pins heads to the ``model`` axis
+    via :func:`cache_spec` — per-step collectives are the same one
+    all-reduce per block as the training forward.
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+
+    cache_sharding = None
+    if mesh is not None:
+        cache_sharding = NamedSharding(
+            mesh, cache_spec(mesh, party_axis, data_axis)
+        )
 
     def sample(logits, key):
         if temperature <= 0.0:
@@ -132,6 +165,11 @@ def make_generate_fn(
         # The cache only ever holds tokens that later tokens attend to, so
         # the final sampled token needs no slot (and no forward pass).
         cache = init_cache(cfg, b, s + max_new_tokens - 1)
+        if cache_sharding is not None:
+            cache = jax.tree_util.tree_map(
+                lambda c: jax.lax.with_sharding_constraint(c, cache_sharding),
+                cache,
+            )
         last_logits, cache = prefill(params, prompt, cache, cfg)
         rng, sub = jax.random.split(rng)
         first = sample(last_logits, sub).astype(prompt.dtype)
@@ -154,4 +192,28 @@ def make_generate_fn(
         new = jnp.concatenate([first[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
         return jnp.concatenate([prompt, new], axis=1)
 
-    return jax.jit(generate) if jit else generate
+    if not jit:
+        return generate
+    if mesh is None:
+        return jax.jit(generate)
+
+    from rayfed_tpu.parallel import sharding as shd
+
+    prompt_sharding = NamedSharding(
+        mesh, shd.batch_spec(mesh, party_axis, data_axis)
+    )
+    jitted = None  # built on first call (param shardings need the tree)
+
+    def sharded_generate(params, prompt, rng: Optional[jax.Array] = None):
+        nonlocal jitted
+        if jitted is None:
+            param_shardings = shd.make_param_shardings(mesh, params)
+            jitted = jax.jit(
+                generate,
+                in_shardings=(param_shardings, prompt_sharding, None),
+            )
+        return jitted(
+            params, prompt, rng if rng is not None else jax.random.PRNGKey(0)
+        )
+
+    return sharded_generate
