@@ -511,3 +511,81 @@ def decode_payload(
     if kind == "pickle":
         return restricted_loads(payload_bytes(payload), allowed_list)
     raise ValueError(f"unknown payload kind: {kind}")
+
+
+# ---------------------------------------------------------------------------
+# Optional wire compression (native lanes only; the reference wire has no
+# equivalent field, so the gRPC parity lane never compresses)
+# ---------------------------------------------------------------------------
+
+COMPRESSION_SCHEMES = ("zlib",)
+
+
+def compress_buffers(buffers, scheme: str, level: int = 1):
+    """Compress the payload buffers into one zlib stream.
+
+    Returns (blob, raw_len) — or None when compression does not shrink the
+    payload (incompressible data ships raw; the header then carries no
+    ``comp`` flag, so the receive path is unchanged). Buffers are fed to
+    the compressor incrementally — the payload is never concatenated, so
+    peak send-side memory is payload + blob, not 2x payload.
+
+    Wire compatibility: a ``comp`` frame is only decodable by a
+    compression-aware build, so ``payload_compression`` requires every
+    receiving party to run one; it is opt-in config, never negotiated.
+    """
+    if scheme not in COMPRESSION_SCHEMES:
+        raise ValueError(
+            f"unknown payload_compression {scheme!r}; "
+            f"supported: {COMPRESSION_SCHEMES}"
+        )
+    import zlib
+
+    c = zlib.compressobj(level)
+    raw_len = 0
+    parts = []
+    for b in buffers:
+        view = memoryview(b).cast("B")
+        raw_len += view.nbytes
+        chunk = c.compress(view)
+        if chunk:
+            parts.append(chunk)
+    parts.append(c.flush())
+    blob = b"".join(parts)
+    if len(blob) >= raw_len:
+        return None
+    return blob, raw_len
+
+
+def decompress_payload(payload, scheme: str, raw_len: int,
+                       max_bytes: Optional[int]) -> memoryview:
+    """Inverse of :func:`compress_buffers`, with decompression-bomb
+    protection: output is bounded by ``max_bytes`` (and must match the
+    header's declared ``rawlen``) BEFORE a full-size buffer can be
+    produced."""
+    if scheme not in COMPRESSION_SCHEMES:
+        raise ValueError(f"unknown compression scheme on wire: {scheme!r}")
+    if raw_len < 0:
+        raise ValueError("compressed frame is missing its rawlen header")
+    cap = raw_len
+    if max_bytes is not None:
+        cap = min(cap, max_bytes)
+    import zlib
+
+    d = zlib.decompressobj()
+    out = d.decompress(payload_bytes(payload), cap + 1)
+    if len(out) > cap or not d.eof or d.unconsumed_tail:
+        raise ValueError(
+            f"compressed payload inflates past its declared/allowed size "
+            f"({cap} bytes)"
+        )
+    if d.unused_data:
+        raise ValueError("trailing bytes after the compressed stream")
+    if len(out) != raw_len:
+        raise ValueError(
+            f"decompressed size {len(out)} != declared rawlen {raw_len}"
+        )
+    # bytearray: receivers promise writable payload views (numpy leaves
+    # decoded from raw frames are writable — sockio.recv_frame pools), so
+    # the compressed path must match.
+    return memoryview(bytearray(out))

@@ -32,8 +32,18 @@ logger = logging.getLogger(__name__)
 DecodeFn = Callable[[Dict, memoryview], object]
 
 
-def default_decode(allowed_list, allow_pickle: bool = True, sharded_fn=None):
+def default_decode(allowed_list, allow_pickle: bool = True, sharded_fn=None,
+                   max_decompressed_bytes: Optional[int] = None):
     def decode(header: Dict, payload) -> object:
+        comp = header.get("comp")
+        if comp:
+            # Bomb-guarded inflate: bounded by the configured payload cap
+            # and the header's declared rawlen before any full-size
+            # allocation.
+            payload = serialization.decompress_payload(
+                payload, comp, int(header.get("rawlen", -1)),
+                max_decompressed_bytes,
+            )
         effective = allowed_list
         if not allow_pickle and header.get("pkind") == "pickle":
             # Strict mode: the only pickle frames that reach decode are

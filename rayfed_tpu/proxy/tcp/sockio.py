@@ -234,7 +234,13 @@ def recv_frame(
     # overwrites every byte); the returned view stays writable.
     from rayfed_tpu._private import serialization
 
-    if plen >= _SEGMENT_THRESHOLD and header.get("pkind") == "tree":
+    # Compressed frames are one opaque blob; scatter-reading by the
+    # (uncompressed) tree extents only applies to raw tree payloads.
+    if (
+        plen >= _SEGMENT_THRESHOLD
+        and header.get("pkind") == "tree"
+        and "comp" not in header
+    ):
         lengths = serialization.tree_segment_lengths(
             header.get("pmeta", b""), plen
         )

@@ -242,6 +242,16 @@ class _DestWorker(threading.Thread):
             "pkind": kind,
             "pmeta": meta,
         }
+        if cfg.payload_compression and payload_len:
+            packed = serialization.compress_buffers(
+                buffers, cfg.payload_compression, cfg.compression_level
+            )
+            if packed is not None:  # incompressible payloads ship raw
+                blob, raw_len = packed
+                header["comp"] = cfg.payload_compression
+                header["rawlen"] = raw_len
+                buffers = [blob]
+                payload_len = len(blob)
         return header, buffers, payload_len
 
     def _send_half_duplex(self, header, buffers) -> bool:
@@ -365,6 +375,7 @@ class TcpReceiverProxy(ReceiverProxy):
         return rendezvous.default_decode(
             self._config.serializing_allowed_list,
             allow_pickle=self._config.allow_pickle_payloads,
+            max_decompressed_bytes=self._config.effective_max_message_bytes(),
         )
 
     # -- lifecycle ------------------------------------------------------------

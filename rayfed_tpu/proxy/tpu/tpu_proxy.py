@@ -30,9 +30,11 @@ class TpuSenderProxy(TcpSenderProxy):
     worker (``np.asarray`` on a jax.Array) off the event loop."""
 
 
-def _device_placer(allowed_list, allow_pickle: bool = True):
+def _device_placer(allowed_list, allow_pickle: bool = True,
+                   max_decompressed_bytes=None):
     base = rendezvous.default_decode(
-        allowed_list, allow_pickle=allow_pickle, sharded_fn=place_sharded
+        allowed_list, allow_pickle=allow_pickle, sharded_fn=place_sharded,
+        max_decompressed_bytes=max_decompressed_bytes,
     )
 
     def decode(header, payload):
@@ -145,4 +147,5 @@ class TpuReceiverProxy(TcpReceiverProxy):
         return _device_placer(
             self._config.serializing_allowed_list,
             allow_pickle=self._config.allow_pickle_payloads,
+            max_decompressed_bytes=self._config.effective_max_message_bytes(),
         )
