@@ -539,6 +539,10 @@ def compress_buffers(buffers, scheme: str, level: int = 1):
             f"unknown payload_compression {scheme!r}; "
             f"supported: {COMPRESSION_SCHEMES}"
         )
+    if not -1 <= level <= 9:
+        raise ValueError(
+            f"compression_level must be in [-1, 9], got {level}"
+        )
     import zlib
 
     c = zlib.compressobj(level)
@@ -567,25 +571,43 @@ def decompress_payload(payload, scheme: str, raw_len: int,
         raise ValueError(f"unknown compression scheme on wire: {scheme!r}")
     if raw_len < 0:
         raise ValueError("compressed frame is missing its rawlen header")
-    cap = raw_len
-    if max_bytes is not None:
-        cap = min(cap, max_bytes)
+    if max_bytes is not None and raw_len > max_bytes:
+        raise ValueError(
+            f"compressed payload declares rawlen {raw_len} past the "
+            f"allowed size ({max_bytes} bytes)"
+        )
     import zlib
 
+    # One allocation (rawlen is capped above before it is trusted), filled
+    # by chunked inflate so a bomb is caught at the first overflowing
+    # chunk; the bytearray keeps the receiver's writable-view promise
+    # (numpy leaves decoded from raw frames come from the recv pool).
+    out = bytearray(raw_len)
+    view = memoryview(out)
+    pos = 0
     d = zlib.decompressobj()
-    out = d.decompress(payload_bytes(payload), cap + 1)
-    if len(out) > cap or not d.eof or d.unconsumed_tail:
-        raise ValueError(
-            f"compressed payload inflates past its declared/allowed size "
-            f"({cap} bytes)"
-        )
+    src = memoryview(payload_bytes(payload))
+    overflow = ValueError(
+        f"compressed payload inflates past its declared size ({raw_len} bytes)"
+    )
+    step = 4 << 20
+    for i in range(0, len(src), step):
+        chunk = d.decompress(src[i: i + step], raw_len - pos + 1)
+        if pos + len(chunk) > raw_len:
+            raise overflow
+        view[pos: pos + len(chunk)] = chunk
+        pos += len(chunk)
+        if d.unconsumed_tail:
+            raise overflow
+    chunk = d.flush()
+    if pos + len(chunk) > raw_len:
+        raise overflow
+    view[pos: pos + len(chunk)] = chunk
+    pos += len(chunk)
     if d.unused_data:
         raise ValueError("trailing bytes after the compressed stream")
-    if len(out) != raw_len:
+    if not d.eof or pos != raw_len:
         raise ValueError(
-            f"decompressed size {len(out)} != declared rawlen {raw_len}"
+            f"decompressed size {pos} != declared rawlen {raw_len}"
         )
-    # bytearray: receivers promise writable payload views (numpy leaves
-    # decoded from raw frames are writable — sockio.recv_frame pools), so
-    # the compressed path must match.
-    return memoryview(bytearray(out))
+    return view

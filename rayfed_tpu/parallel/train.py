@@ -19,6 +19,7 @@ import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -43,6 +44,8 @@ def make_fed_train_step(
     lr: float = 3e-4,
     remat: bool = False,
     attn: str = "auto",
+    accum_steps: int = 1,
+    shard_opt_state: bool = False,
 ):
     """Build (init_fn, step_fn) jitted over ``mesh``.
 
@@ -59,6 +62,19 @@ def make_fed_train_step(
     over that axis; with flash selected, each ring step runs through the
     Pallas kernels (``ring_flash_attention``) so per-device memory stays
     O(S_local) even at very long context.
+
+    ``accum_steps > 1`` splits the global batch into that many
+    microbatches and accumulates gradients under one ``lax.scan`` —
+    activation memory scales with the microbatch while the update sees
+    the full-batch gradient (mean of equal-sized microbatch means, f32
+    accumulation; matches the single-pass gradient up to float
+    reduction-order rounding, ~1e-5 relative).
+
+    ``shard_opt_state=True`` additionally shards optimizer moments
+    ZeRO-1 style: any moment dim the parameter rules leave unsharded is
+    sharded over party x data when divisible, cutting optimizer memory by
+    the dp world size; XLA inserts the per-step all-gather on the
+    update path automatically.
     """
     optimizer = make_optimizer(lr)
     use_ring = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
@@ -109,18 +125,114 @@ def make_fed_train_step(
             loss_chunk=loss_chunk,
         )
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grad_step(params, inputs, targets):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, inputs, targets)
+        b, s = inputs.shape
+        if b % accum_steps:
+            raise ValueError(
+                f"batch {b} not divisible by accum_steps={accum_steps}"
+            )
+        mb = b // accum_steps
+        xs = inputs.reshape(accum_steps, mb, s)
+        ts = targets.reshape(accum_steps, mb, s)
+
+        def body(carry, xt):
+            acc_loss, acc_grads = carry
+            x, t = xt
+            loss, grads = jax.value_and_grad(loss_fn)(params, x, t)
+            acc_grads = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), acc_grads, grads
+            )
+            return (acc_loss + loss, acc_grads), None
+
+        init = (
+            jnp.zeros((), jnp.float32),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            ),
+        )
+        (tot_loss, tot_grads), _ = jax.lax.scan(body, init, (xs, ts))
+        inv = 1.0 / accum_steps
+        grads = jax.tree_util.tree_map(
+            lambda p, g: (g * inv).astype(p.dtype), params, tot_grads
+        )
+        return tot_loss * inv, grads
+
     def step(params, opt_state, inputs, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, inputs, targets)
+        loss, grads = grad_step(params, inputs, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    if shard_opt_state:
+        dp_axes = tuple(
+            a for a in (party_axis, data_axis)
+            if a and a in mesh.axis_names and mesh.shape[a] > 1
+        )
+        dp_size = 1
+        for a in dp_axes:
+            dp_size *= mesh.shape[a]
+
+        def _zero1(param_spec: P, leaf) -> NamedSharding:
+            # Extend the parameter's own spec (moments keep the tp layout)
+            # by sharding the first unsharded, divisible dim over the dp
+            # axes — ZeRO-1: each dp rank keeps 1/dp of the moments.
+            spec = list(param_spec) + [None] * (leaf.ndim - len(param_spec))
+            if dp_size > 1:
+                for i, entry in enumerate(spec):
+                    if entry is None and leaf.shape[i] and \
+                            leaf.shape[i] % dp_size == 0:
+                        spec[i] = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+                        break
+            return NamedSharding(mesh, P(*spec))
+
+        def _dict_path(path):
+            return tuple(
+                p.key for p in path
+                if isinstance(p, jax.tree_util.DictKey)
+            )
+
+        def _opt_shardings(params):
+            is_spec = lambda x: isinstance(x, P)  # noqa: E731
+            param_specs = jax.tree_util.tree_map(
+                lambda s: shd.prune_spec_to_mesh(s, mesh),
+                shd.make_param_specs(params), is_leaf=is_spec,
+            )
+            # optax states embed param-shaped dict trees (mu/nu); an opt
+            # leaf's dict-key path equals its parameter's, while non-param
+            # leaves (count scalars) match nothing and replicate.
+            flat_specs = {
+                _dict_path(path): spec
+                for path, spec in jax.tree_util.tree_flatten_with_path(
+                    param_specs, is_leaf=is_spec
+                )[0]
+            }
+            opt_shapes = jax.eval_shape(optimizer.init, params)
+
+            def for_leaf(path, leaf):
+                spec = flat_specs.get(_dict_path(path))
+                if spec is None or leaf.ndim < len(spec):
+                    spec = P()
+                return _zero1(spec, leaf)
+
+            return jax.tree_util.tree_map_with_path(for_leaf, opt_shapes)
+
     def init_fn(rng, sample_tokens):
         params = tfm.init_params(rng, cfg)
         params = shd.shard_params(mesh, params)
-        # Moment tensors inherit each parameter's sharding via XLA's
-        # sharding propagation — no explicit out_shardings needed.
-        opt_state = jax.jit(optimizer.init)(params)
+        if shard_opt_state:
+            shardings = _opt_shardings(params)
+            opt_state = jax.jit(
+                optimizer.init, out_shardings=shardings
+            )(params)
+        else:
+            # Moment tensors inherit each parameter's sharding via XLA's
+            # sharding propagation — no explicit out_shardings needed.
+            opt_state = jax.jit(optimizer.init)(params)
         return params, opt_state
 
     step_fn = jax.jit(

@@ -42,9 +42,13 @@ def test_decompression_bomb_guards():
     # Declared rawlen smaller than reality -> rejected.
     with pytest.raises(ValueError, match="inflates past"):
         serialization.decompress_payload(blob, "zlib", 1000, None)
-    # Receiver-side cap smaller than the payload -> rejected.
-    with pytest.raises(ValueError, match="inflates past"):
+    # Receiver-side cap smaller than the payload -> rejected before any
+    # rawlen-sized allocation.
+    with pytest.raises(ValueError, match="past the allowed size"):
         serialization.decompress_payload(blob, "zlib", len(raw), 4096)
+    # Out-of-range compression level -> config-shaped error at send time.
+    with pytest.raises(ValueError, match="compression_level"):
+        serialization.compress_buffers([b"x" * 100], "zlib", level=10)
     # Missing rawlen header -> rejected (never an unbounded inflate).
     with pytest.raises(ValueError, match="missing its rawlen"):
         serialization.decompress_payload(blob, "zlib", -1, None)
