@@ -578,36 +578,42 @@ def decompress_payload(payload, scheme: str, raw_len: int,
         )
     import zlib
 
-    # One allocation (rawlen is capped above before it is trusted), filled
-    # by chunked inflate so a bomb is caught at the first overflowing
-    # chunk; the bytearray keeps the receiver's writable-view promise
-    # (numpy leaves decoded from raw frames come from the recv pool).
-    out = bytearray(raw_len)
-    view = memoryview(out)
+    # Chunked inflate: a bomb is caught at the first chunk that overflows
+    # the declared rawlen, and the bytearray keeps the receiver's
+    # writable-view promise (numpy leaves decoded from raw frames come
+    # from the recv pool). rawlen is only trusted for preallocation after
+    # the cap validated it; in explicit no-cap deployments the buffer
+    # grows with the actual inflated bytes instead, so a forged header
+    # can never trigger a large allocation by itself.
+    bounded = max_bytes is not None
+    out = bytearray(raw_len if bounded else 0)
     pos = 0
+
+    def put(chunk):
+        nonlocal pos
+        if not chunk:
+            return
+        if pos + len(chunk) > raw_len:
+            raise ValueError(
+                f"compressed payload inflates past its declared size "
+                f"({raw_len} bytes)"
+            )
+        if bounded:
+            out[pos: pos + len(chunk)] = chunk
+        else:
+            out.extend(chunk)
+        pos += len(chunk)
+
     d = zlib.decompressobj()
     src = memoryview(payload_bytes(payload))
-    overflow = ValueError(
-        f"compressed payload inflates past its declared size ({raw_len} bytes)"
-    )
     step = 4 << 20
     for i in range(0, len(src), step):
-        chunk = d.decompress(src[i: i + step], raw_len - pos + 1)
-        if pos + len(chunk) > raw_len:
-            raise overflow
-        view[pos: pos + len(chunk)] = chunk
-        pos += len(chunk)
-        if d.unconsumed_tail:
-            raise overflow
-    chunk = d.flush()
-    if pos + len(chunk) > raw_len:
-        raise overflow
-    view[pos: pos + len(chunk)] = chunk
-    pos += len(chunk)
+        put(d.decompress(src[i: i + step], raw_len - pos + 1))
+    put(d.flush())
     if d.unused_data:
         raise ValueError("trailing bytes after the compressed stream")
     if not d.eof or pos != raw_len:
         raise ValueError(
             f"decompressed size {pos} != declared rawlen {raw_len}"
         )
-    return view
+    return memoryview(out)

@@ -137,8 +137,20 @@ def make_fed_train_step(
                 f"batch {b} not divisible by accum_steps={accum_steps}"
             )
         mb = b // accum_steps
-        xs = inputs.reshape(accum_steps, mb, s)
-        ts = targets.reshape(accum_steps, mb, s)
+        # Strided split (microbatch i = rows i::accum_steps), NOT
+        # contiguous chunks: the batch dim is sharded over party x data,
+        # and a contiguous microbatch would hold only some shards' rows —
+        # XLA would then reshard raw token data across parties every
+        # step. Strided microbatches take an equal slice of every dp
+        # shard (zero-communication when mb divides by the dp extent);
+        # the constraint pins that layout for GSPMD.
+        mb_sharding = NamedSharding(mesh, P(None, *batch_pspec))
+
+        def split(t):
+            t = jnp.moveaxis(t.reshape(mb, accum_steps, s), 1, 0)
+            return jax.lax.with_sharding_constraint(t, mb_sharding)
+
+        xs, ts = split(inputs), split(targets)
 
         def body(carry, xt):
             acc_loss, acc_grads = carry

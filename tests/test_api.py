@@ -97,9 +97,33 @@ def run_local_pipeline(party, addresses):
     fed.shutdown()
 
 
+
+
+def run_occupied_port(party, addresses):
+    """A receiver bound to an occupied port must fail fed.init with an
+    AssertionError (ref ``fed/tests/test_listening_address.py``), not
+    hang or listen elsewhere."""
+    import socket
+
+    import rayfed_tpu as fed
+
+    blocker = socket.socket()
+    host, port = addresses[party].split(":")
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind((host, int(port)))
+    blocker.listen(1)
+    try:
+        with pytest.raises(AssertionError, match="[Aa]ddress|in use|bind"):
+            fed.init(addresses=addresses, party=party)
+    finally:
+        blocker.close()
+
+
+
 @pytest.mark.parametrize(
     "target",
-    [run_init_asserts, run_repeat_init, run_kv_lifecycle, run_local_pipeline],
+    [run_init_asserts, run_repeat_init, run_kv_lifecycle, run_local_pipeline,
+     run_occupied_port],
 )
 def test_single_party(target):
     run_parties(target, ["alice"])
