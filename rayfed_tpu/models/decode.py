@@ -127,6 +127,8 @@ def make_generate_fn(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     jit: bool = True,
     mesh: Optional[Mesh] = None,
     party_axis: Optional[str] = "party",
@@ -135,8 +137,11 @@ def make_generate_fn(
     """Build ``generate(params, prompt, rng=None) -> (B, S+max_new)``.
 
     Greedy when ``temperature == 0`` (rng unused), otherwise softmax
-    sampling at the given temperature. Lengths are static: the returned
-    function compiles once per prompt shape.
+    sampling at the given temperature, optionally truncated to the
+    ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
+    (smallest set of tokens whose probability mass reaches ``top_p``).
+    Lengths are static: the returned function compiles once per prompt
+    shape.
 
     With ``mesh``, decoding runs sharded: params follow the Megatron tp
     rules (:mod:`rayfed_tpu.parallel.sharding`), the prompt/batch shards
@@ -146,6 +151,16 @@ def make_generate_fn(
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    if top_k is not None and not 1 <= top_k <= cfg.vocab:
+        raise ValueError(f"top_k must be in [1, {cfg.vocab}], got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature <= 0.0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p truncate the sampling distribution; with "
+            "temperature<=0 decoding is greedy and they would be silently "
+            "ignored — set temperature > 0"
+        )
 
     cache_sharding = None
     if mesh is not None:
@@ -156,7 +171,23 @@ def make_generate_fn(
     def sample(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
+        logits = logits / temperature
+        if top_k is not None and top_k < cfg.vocab:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None and top_p < 1.0:
+            desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            cum_excl = jnp.cumsum(
+                jax.nn.softmax(desc, axis=-1), axis=-1
+            ) - jax.nn.softmax(desc, axis=-1)
+            # Nucleus = tokens whose exclusive cumulative mass is still
+            # under top_p (always contains the argmax); mask the rest.
+            thresh = jnp.min(
+                jnp.where(cum_excl < top_p, desc, jnp.inf),
+                axis=-1, keepdims=True,
+            )
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1)
 
     def generate(params, prompt, rng: Optional[jax.Array] = None):
         b, s = prompt.shape

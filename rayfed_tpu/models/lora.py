@@ -1,0 +1,136 @@
+"""LoRA adapters for the flagship transformer (parameter-efficient
+federated finetuning).
+
+No reference equivalent (the reference ships no models, SURVEY.md §0) —
+but the pattern is a natural fit for a federated engine: parties train
+low-rank deltas locally and push/aggregate ONLY the adapter tree, which
+is orders of magnitude smaller than the base weights, so every FedAvg
+round's wire cost drops accordingly (examples/test: ~1-2%% of the full
+push).
+
+TPU-first shape choices: adapters are stacked over layers like the base
+parameters (one (L, ..., r) leaf per target), so the merged forward is
+still a single ``lax.scan`` over layers, and merging is one einsum per
+target that XLA fuses into the surrounding step. ``b`` starts at zero —
+step 0 reproduces the base model exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from rayfed_tpu.models import transformer as tfm
+
+Params = Dict[str, Any]
+
+# target -> (einsum for delta, a-shape builder, b-shape builder); shapes
+# carry the stacked leading n_layers dim.
+_TARGETS = {
+    "wq": ("ldr,lrhk->ldhk", lambda d, h, dh, f, r: ((d, r), (r, h, dh))),
+    "wk": ("ldr,lrhk->ldhk", lambda d, h, dh, f, r: ((d, r), (r, h, dh))),
+    "wv": ("ldr,lrhk->ldhk", lambda d, h, dh, f, r: ((d, r), (r, h, dh))),
+    "wo": ("lhkr,lrd->lhkd", lambda d, h, dh, f, r: ((h, dh, r), (r, d))),
+    "w_gate": ("ldr,lrf->ldf", lambda d, h, dh, f, r: ((d, r), (r, f))),
+    "w_up": ("ldr,lrf->ldf", lambda d, h, dh, f, r: ((d, r), (r, f))),
+    "w_down": ("lfr,lrd->lfd", lambda d, h, dh, f, r: ((f, r), (r, d))),
+}
+
+ATTN_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(
+    rng,
+    cfg: tfm.TransformerConfig,
+    rank: int = 8,
+    targets: Sequence[str] = ATTN_TARGETS,
+    alpha: float | None = None,
+    dtype=None,
+) -> Params:
+    """A LoRA tree {"layers": {target: {"a": ..., "b": ...}}, "scale"-free}:
+    ``a`` is N(0, 1/rank)-initialized, ``b`` zero, so the initial delta is
+    exactly zero. ``alpha`` defaults to ``rank`` (scale 1.0)."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    unknown = set(targets) - set(_TARGETS)
+    if unknown:
+        raise ValueError(f"unknown LoRA targets: {sorted(unknown)}")
+    if cfg.n_experts > 0 and set(targets) & {"w_gate", "w_up", "w_down"}:
+        raise ValueError(
+            "MoE configs have no dense FFN weights; LoRA targets must be "
+            f"attention-only ({ATTN_TARGETS})"
+        )
+    dtype = dtype or cfg.param_dtype
+    d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff
+    keys = jax.random.split(rng, len(targets))
+    layers = {}
+    for key, t in zip(keys, targets):
+        a_shape, b_shape = _TARGETS[t][1](d, h, dh, f, rank)
+        layers[t] = {
+            "a": (
+                jax.random.normal(key, (cfg.n_layers,) + a_shape)
+                * (rank**-0.5)
+            ).astype(dtype),
+            "b": jnp.zeros((cfg.n_layers,) + b_shape, dtype),
+        }
+    return {"layers": layers, "alpha": float(alpha if alpha else rank),
+            "rank": rank}
+
+
+def merge_lora(params: Params, lora: Params) -> Params:
+    """Base params with every adapter folded in:
+    ``W' = W + (alpha / rank) * a @ b``. Gradients through the merge flow
+    only into the adapter leaves when the caller differentiates w.r.t.
+    ``lora``; the base tree is shared, untouched, and never copied except
+    for the targeted leaves."""
+    scale = lora["alpha"] / lora["rank"]
+    new_layers = dict(params["layers"])
+    for t, ab in lora["layers"].items():
+        eq = _TARGETS[t][0]
+        w = params["layers"][t]
+        delta = jnp.einsum(eq, ab["a"], ab["b"]) * scale
+        new_layers[t] = w + delta.astype(w.dtype)
+    out = dict(params)
+    out["layers"] = new_layers
+    return out
+
+
+def lora_loss(params: Params, lora: Params, inputs, targets,
+              cfg: tfm.TransformerConfig, **kw) -> jax.Array:
+    """LM loss of the merged model; differentiate w.r.t. ``lora`` only
+    for parameter-efficient training."""
+    return tfm.lm_loss_pair(merge_lora(params, lora), inputs, targets,
+                            cfg, **kw)
+
+
+def make_lora_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3):
+    """(step_fn) jitted: ``step(params, lora, opt_state, inputs, targets)
+    -> (lora, opt_state, loss)``. The base params are frozen (no
+    gradient, no optimizer state); only the adapter tree updates. Use
+    ``optax.adam(lr).init(lora_weights(lora))`` for the state."""
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def step(params, lora, opt_state, inputs, targets):
+        def loss_fn(ab_tree):
+            live = dict(lora)
+            live["layers"] = ab_tree
+            return lora_loss(params, live, inputs, targets, cfg)
+
+        loss, grads = jax.value_and_grad(loss_fn)(lora["layers"])
+        updates, opt_state = optimizer.update(grads, opt_state)
+        new = dict(lora)
+        new["layers"] = optax.apply_updates(lora["layers"], updates)
+        return new, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(2,)), optimizer
+
+
+def lora_nbytes(lora: Params) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(lora["layers"])
+    )

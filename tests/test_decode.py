@@ -146,3 +146,44 @@ def test_sharded_generate_matches_single_device():
     out = gen(sharded_params, prompt)
 
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_topk_one_equals_greedy():
+    cfg = _cfg()
+    params = tfm.init_params(jax.random.PRNGKey(30), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(31), (2, 4), 0, cfg.vocab)
+    greedy = decode.make_generate_fn(cfg, max_new_tokens=5)(params, prompt)
+    topk1 = decode.make_generate_fn(
+        cfg, max_new_tokens=5, temperature=0.7, top_k=1
+    )(params, prompt, jax.random.PRNGKey(32))
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+
+def test_topk_topp_sampling_stays_in_nucleus():
+    cfg = _cfg()
+    params = tfm.init_params(jax.random.PRNGKey(33), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(34), (2, 4), 0, cfg.vocab)
+    gen = decode.make_generate_fn(
+        cfg, max_new_tokens=6, temperature=1.0, top_k=16, top_p=0.9
+    )
+    out = np.asarray(gen(params, prompt, jax.random.PRNGKey(35)))
+    assert out.shape == (2, 10)
+    # Every sampled token must be one of the top-16 next-token candidates
+    # for its prefix (checked against the full forward).
+    seq = np.asarray(prompt)
+    for step in range(6):
+        logits = np.asarray(tfm.forward(params, jnp.asarray(seq), cfg))
+        top16 = np.argsort(logits[:, -1], axis=-1)[:, -16:]
+        for b in range(2):
+            assert out[b, 4 + step] in top16[b]
+        seq = np.concatenate([seq, out[:, 4 + step][:, None]], axis=1)
+
+
+def test_sampling_params_validated():
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="top_k"):
+        decode.make_generate_fn(cfg, max_new_tokens=2, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        decode.make_generate_fn(cfg, max_new_tokens=2, top_p=0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        decode.make_generate_fn(cfg, max_new_tokens=2, top_p=1.5)
