@@ -24,8 +24,26 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def enable_compilation_cache():
+    """Point JAX at the repo-local persistent compilation cache so the
+    flagship step compiles once per (program, jaxlib, chip) ever — a
+    driver/bench run on a warm cache skips the multi-minute XLA compile
+    that previously ate the whole measurement budget (VERDICT r2 #1)."""
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache",
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
 def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
         attn="auto", peak_tflops=197.0, vocab=8192):
+    enable_compilation_cache()
+
     import jax
     import numpy as np
     from jax.sharding import Mesh, NamedSharding
@@ -101,13 +119,23 @@ def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
 def main():
     args = [int(a) for a in sys.argv[1:4]]
     remat_env = os.environ.get("REMAT")
+    # REMAT accepts 0/1/attn: "attn" = checkpoint layers but save each
+    # layer's attention output, so the backward never re-runs the flash
+    # kernel (see transformer.hidden_states).
+    if remat_env is None:
+        remat = None
+    elif remat_env == "attn":
+        remat = "attn"
+    else:
+        remat = remat_env == "1"
     run(
         *args,
         batch=int(os.environ.get("BATCH", 8)),
         steps=int(os.environ.get("STEPS", 20)),
-        remat=None if remat_env is None else remat_env == "1",
+        remat=remat,
         attn=os.environ.get("ATTN", "auto"),
         peak_tflops=float(os.environ.get("PEAK_TFLOPS", 197.0)),
+        vocab=int(os.environ.get("VOCAB", 8192)),
     )
 
 

@@ -518,11 +518,27 @@ def decode_payload(
 # equivalent field, so the gRPC parity lane never compresses)
 # ---------------------------------------------------------------------------
 
-COMPRESSION_SCHEMES = ("zlib",)
+COMPRESSION_SCHEMES = ("zlib", "zstd")
+
+# Valid compression_level range per scheme (zstd: negative = fast modes).
+_LEVEL_RANGES = {"zlib": (-1, 9), "zstd": (-22, 22)}
+
+
+def _check_scheme_level(scheme: str, level: int, knob: str) -> None:
+    if scheme not in COMPRESSION_SCHEMES:
+        raise ValueError(
+            f"unknown {knob} {scheme!r}; supported: {COMPRESSION_SCHEMES}"
+        )
+    lo, hi = _LEVEL_RANGES[scheme]
+    if not lo <= level <= hi:
+        raise ValueError(
+            f"compression_level must be in [{lo}, {hi}] for "
+            f"{scheme}, got {level}"
+        )
 
 
 def compress_buffers(buffers, scheme: str, level: int = 1):
-    """Compress the payload buffers into one zlib stream.
+    """Compress the payload buffers into one stream (zlib or zstd).
 
     Returns (blob, raw_len) — or None when compression does not shrink the
     payload (incompressible data ships raw; the header then carries no
@@ -530,22 +546,25 @@ def compress_buffers(buffers, scheme: str, level: int = 1):
     the compressor incrementally — the payload is never concatenated, so
     peak send-side memory is payload + blob, not 2x payload.
 
-    Wire compatibility: a ``comp`` frame is only decodable by a
-    compression-aware build, so ``payload_compression`` requires every
-    receiving party to run one; it is opt-in config, never negotiated.
-    """
-    if scheme not in COMPRESSION_SCHEMES:
-        raise ValueError(
-            f"unknown payload_compression {scheme!r}; "
-            f"supported: {COMPRESSION_SCHEMES}"
-        )
-    if not -1 <= level <= 9:
-        raise ValueError(
-            f"compression_level must be in [-1, 9], got {level}"
-        )
-    import zlib
+    ``zstd`` is the codec of choice for gradient/weight data: at level 1-3
+    it compresses comparably to zlib-6 at several times the speed
+    (zlib stays supported for deployments pinning the earlier wire).
 
-    c = zlib.compressobj(level)
+    Wire compatibility: a ``comp`` frame is only decodable by a
+    compression-aware build supporting that scheme (the receiver fails
+    the frame with a clear error otherwise), so ``payload_compression``
+    requires every receiving party to run one; it is opt-in config,
+    never negotiated silently.
+    """
+    _check_scheme_level(scheme, level, "payload_compression")
+    if scheme == "zstd":
+        import zstandard
+
+        c = zstandard.ZstdCompressor(level=level).compressobj()
+    else:
+        import zlib
+
+        c = zlib.compressobj(level)
     raw_len = 0
     parts = []
     for b in buffers:
@@ -576,8 +595,6 @@ def decompress_payload(payload, scheme: str, raw_len: int,
             f"compressed payload declares rawlen {raw_len} past the "
             f"allowed size ({max_bytes} bytes)"
         )
-    import zlib
-
     # Chunked inflate: a bomb is caught at the first chunk that overflows
     # the declared rawlen, and the bytearray keeps the receiver's
     # writable-view promise (numpy leaves decoded from raw frames come
@@ -604,9 +621,38 @@ def decompress_payload(payload, scheme: str, raw_len: int,
             out.extend(chunk)
         pos += len(chunk)
 
-    d = zlib.decompressobj()
     src = memoryview(payload_bytes(payload))
     step = 4 << 20
+    if scheme == "zstd":
+        import zstandard
+
+        # stream_reader bounds OUTPUT per read call, so a bomb never
+        # materialises more than one step past the declared size no
+        # matter how extreme the ratio of a single compressed block.
+        # Trailing bytes after the frame are rejected too: the reader
+        # parses them as a following frame — garbage fails the frame
+        # header, a real second frame overflows the declared rawlen
+        # (both pinned in tests). The one undetectable tail is a valid
+        # zero-output empty frame, which contributes no bytes.
+        reader = zstandard.ZstdDecompressor().stream_reader(src)
+        try:
+            while True:
+                want = min(step, raw_len - pos + 1)
+                chunk = reader.read(max(1, want))
+                if not chunk:
+                    break
+                put(chunk)
+        except zstandard.ZstdError as e:
+            raise ValueError(f"corrupt zstd stream: {e}") from None
+        if pos != raw_len:
+            raise ValueError(
+                f"decompressed size {pos} != declared rawlen {raw_len}"
+            )
+        return memoryview(out)
+
+    import zlib
+
+    d = zlib.decompressobj()
     for i in range(0, len(src), step):
         put(d.decompress(src[i: i + step], raw_len - pos + 1))
     put(d.flush())

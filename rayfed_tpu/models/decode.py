@@ -233,13 +233,18 @@ def make_generate_fn(
     prompt_sharding = NamedSharding(
         mesh, shd.batch_spec(mesh, party_axis, data_axis)
     )
-    jitted = None  # built on first call (param shardings need the tree)
+    # Jitted fns are keyed on the param tree's structure/shapes/dtypes:
+    # a later call with a different tree (e.g. LoRA-merged vs base) gets
+    # its own in_shardings instead of reusing stale ones.
+    jitted_by_tree = {}
 
     def sharded_generate(params, prompt, rng: Optional[jax.Array] = None):
-        nonlocal jitted
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef, tuple((x.shape, x.dtype) for x in leaves))
+        jitted = jitted_by_tree.get(key)
         if jitted is None:
             param_shardings = shd.make_param_shardings(mesh, params)
-            jitted = jax.jit(
+            jitted = jitted_by_tree[key] = jax.jit(
                 generate,
                 in_shardings=(param_shardings, prompt_sharding, None),
             )

@@ -75,7 +75,10 @@ def init_lora(
             ).astype(dtype),
             "b": jnp.zeros((cfg.n_layers,) + b_shape, dtype),
         }
-    return {"layers": layers, "alpha": float(alpha if alpha else rank),
+    if alpha is not None and alpha <= 0:
+        raise ValueError(f"alpha must be > 0, got {alpha}")
+    return {"layers": layers,
+            "alpha": float(alpha if alpha is not None else rank),
             "rank": rank}
 
 
@@ -109,7 +112,7 @@ def make_lora_train_step(cfg: tfm.TransformerConfig, lr: float = 1e-3):
     """(step_fn) jitted: ``step(params, lora, opt_state, inputs, targets)
     -> (lora, opt_state, loss)``. The base params are frozen (no
     gradient, no optimizer state); only the adapter tree updates. Use
-    ``optax.adam(lr).init(lora_weights(lora))`` for the state."""
+    ``optax.adam(lr).init(lora["layers"])`` for the initial state."""
     import optax
 
     optimizer = optax.adam(lr)

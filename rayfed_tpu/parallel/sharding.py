@@ -76,14 +76,32 @@ def make_param_specs(params, rules=TRANSFORMER_RULES):
 
 def prune_spec_to_mesh(spec: P, mesh: Mesh) -> P:
     """Drop axis names the mesh does not have (e.g. the 'model' rules on a
-    party x expert mesh): absent axes mean 'replicated here'."""
+    party x expert mesh): absent axes mean 'replicated here'.
+
+    One deliberate fallback: on a mesh with no ``expert`` axis but a
+    ``model`` axis, the expert dimension shards over ``model`` instead of
+    replicating — MoE composes into the flagship party x data x model
+    (x seq) mesh without a fifth axis, Megatron-style (experts ride the
+    tp group; XLA inserts the cross-expert collectives). Configs should
+    keep ``n_experts`` divisible by the model-axis size."""
+    def one(name):
+        if name in mesh.axis_names:
+            return name
+        if name == "expert" and "model" in mesh.axis_names:
+            return "model"
+        return None
+
     def keep(entry):
         if entry is None:
             return None
         if isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if a in mesh.axis_names)
-            return kept if kept else None
-        return entry if entry in mesh.axis_names else None
+            kept = []
+            for a in entry:
+                m = one(a)
+                if m is not None and m not in kept:
+                    kept.append(m)
+            return tuple(kept) if kept else None
+        return one(entry)
 
     return P(*(keep(e) for e in spec))
 

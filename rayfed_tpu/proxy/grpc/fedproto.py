@@ -120,7 +120,12 @@ def decode_send_data_request(buf) -> Tuple[Union[bytes, memoryview], str, str, s
 
     The payload is a zero-copy ``memoryview`` into ``buf`` when present
     (``b""`` when absent) — callers needing ``bytes`` semantics must wrap
-    it themselves; it keeps ``buf`` alive while referenced."""
+    it themselves; it keeps ``buf`` alive while referenced. The header
+    fields pinned alongside are a few dozen bytes next to the payload
+    itself, an acceptable trade for skipping a full payload copy —
+    but consumers that *queue* the payload (e.g. a rendezvous store
+    awaiting a slow reader) should materialize or release it promptly
+    rather than pin the request buffer indefinitely."""
     f = _parse(buf)
     return (
         f.get(1, b""),

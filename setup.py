@@ -32,6 +32,7 @@ setup(
         "msgpack",
         "cloudpickle",
         "cryptography",
+        "zstandard",
     ],
     extras_require={
         "tpu": ["jax", "optax"],

@@ -1,6 +1,6 @@
-"""Optional wire compression on the native lanes: helper round-trips with
-decompression-bomb guards, plus a two-party push with
-``payload_compression='zlib'`` (no reference equivalent — the reference
+"""Optional wire compression on the native lanes (zlib + zstd): helper
+round-trips with decompression-bomb guards, plus two-party pushes with
+``payload_compression`` set (no reference equivalent — the reference
 wire carries raw cloudpickle bytes only)."""
 
 import numpy as np
@@ -11,20 +11,22 @@ from rayfed_tpu._private import serialization
 from tests.utils import FAST_COMM_CONFIG, run_parties
 
 
-def test_compress_roundtrip():
+@pytest.mark.parametrize("scheme", ["zlib", "zstd"])
+def test_compress_roundtrip(scheme):
     buffers = [b"abc" * 1000, np.zeros(1000, np.float32)]
-    blob, raw_len = serialization.compress_buffers(buffers, "zlib")
+    blob, raw_len = serialization.compress_buffers(buffers, scheme)
     raw = b"".join(memoryview(b).cast("B") for b in buffers)
     assert raw_len == len(raw)
     assert len(blob) < raw_len
-    out = serialization.decompress_payload(blob, "zlib", raw_len, None)
+    out = serialization.decompress_payload(blob, scheme, raw_len, None)
     assert bytes(out) == raw
 
 
-def test_incompressible_ships_raw():
+@pytest.mark.parametrize("scheme", ["zlib", "zstd"])
+def test_incompressible_ships_raw(scheme):
     rng = np.random.default_rng(0)
     noise = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
-    assert serialization.compress_buffers([noise], "zlib") is None
+    assert serialization.compress_buffers([noise], scheme) is None
 
 
 def test_unknown_scheme_rejected():
@@ -59,9 +61,11 @@ def test_decompression_bomb_guards():
         )
 
 
-def run_compressed_push(party, addresses, transport):
+def run_compressed_push(party, addresses, transport, scheme="zlib"):
     comm = dict(FAST_COMM_CONFIG)
-    comm["payload_compression"] = "zlib"
+    comm["payload_compression"] = scheme
+    if scheme == "zstd":
+        comm["compression_level"] = 3
     fed.init(
         addresses=addresses,
         party=party,
@@ -95,6 +99,48 @@ def run_compressed_push(party, addresses, transport):
 
 def test_two_party_compressed_push_tcp():
     run_parties(run_compressed_push, ["alice", "bob"], extra_args=("tcp",))
+
+
+def test_two_party_zstd_push_tcp():
+    run_parties(
+        run_compressed_push, ["alice", "bob"], extra_args=("tcp", "zstd")
+    )
+
+
+def test_zstd_bomb_guards():
+    import zstandard
+
+    raw = b"\x00" * 1_000_000
+    blob = zstandard.ZstdCompressor(level=3).compress(raw)
+    # Declared rawlen smaller than reality -> rejected without a
+    # full-size materialisation.
+    with pytest.raises(ValueError, match="inflates past"):
+        serialization.decompress_payload(blob, "zstd", 1000, None)
+    # Receiver-side cap smaller than the payload -> rejected up front.
+    with pytest.raises(ValueError, match="past the allowed size"):
+        serialization.decompress_payload(blob, "zstd", len(raw), 4096)
+    # Truncated/declared-too-large stream -> size mismatch error.
+    with pytest.raises(ValueError, match="!= declared rawlen"):
+        serialization.decompress_payload(blob, "zstd", len(raw) + 5, None)
+    # Corrupt stream -> clean ValueError, not a zstd traceback.
+    with pytest.raises(ValueError, match="corrupt zstd stream"):
+        serialization.decompress_payload(
+            b"\x12\x34" + blob[2:], "zstd", len(raw), None
+        )
+    # zstd levels are validated on their own range.
+    with pytest.raises(ValueError, match="compression_level"):
+        serialization.compress_buffers([b"x" * 100], "zstd", level=23)
+    # Trailing garbage after the frame -> rejected (parsed as a next
+    # frame, which fails its header check).
+    with pytest.raises(ValueError, match="corrupt zstd stream"):
+        serialization.decompress_payload(
+            blob + b"junk", "zstd", len(raw), None
+        )
+    # A valid SECOND frame appended -> rejected (inflates past rawlen).
+    with pytest.raises(ValueError, match="inflates past"):
+        serialization.decompress_payload(
+            blob + blob, "zstd", len(raw), None
+        )
 
 
 def test_decompressed_arrays_are_writable():

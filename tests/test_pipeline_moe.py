@@ -488,3 +488,87 @@ def test_1f1b_composes_with_tp_and_party():
     f_params, f_opt = init_fn(jax.random.PRNGKey(11), inputs)
     _, _, f_loss = step_fn(f_params, f_opt, inputs, targets)
     np.testing.assert_allclose(float(f_loss), float(g_loss), rtol=1e-5)
+
+
+def test_moe_composes_into_flagship_mesh_matches_single_device():
+    """MoE (experts sharded over the ``model`` axis via the
+    prune_spec_to_mesh fallback) inside the composed party x data x model
+    x seq train step equals the same step on one device (VERDICT r2 #6)."""
+    from jax.sharding import NamedSharding
+
+    from rayfed_tpu.parallel import sharding as shd
+    from rayfed_tpu.parallel.train import make_fed_train_step
+
+    cfg = tfm.tiny_config(n_experts=4, compute_dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, cfg.vocab)
+
+    def loss_and_grads(mesh, seq_axis):
+        init_fn, step_fn = make_fed_train_step(
+            cfg, mesh, seq_axis=seq_axis, lr=1e-2,
+        )
+        sharding = NamedSharding(mesh, shd.batch_spec(mesh, seq_axis=seq_axis))
+        inputs = jax.device_put(tokens[:, :-1], sharding)
+        targets = jax.device_put(tokens[:, 1:], sharding)
+        params, opt_state = init_fn(jax.random.PRNGKey(0), inputs)
+        # Equivalence is pinned on loss + raw grads: comparing post-Adam
+        # params would amplify float-rounding grad noise to O(lr)
+        # wherever a gradient is near zero (sign-like first step).
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: tfm.lm_loss_pair(p, inputs, targets, cfg)
+        ))(params)
+        spec = tuple(params["layers"]["moe"]["w_up"].sharding.spec)
+        # One full step must also run and stay finite (exercises the
+        # composed update path; donates params/opt_state, so last).
+        _, _, step_loss = step_fn(params, opt_state, inputs, targets)
+        assert np.isfinite(float(step_loss))
+        return float(loss), grads, spec
+
+    composed = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 1, 2, 2),
+        ("party", "data", "model", "seq"),
+    )
+    single = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1, 1),
+                  ("party", "data", "model", "seq"))
+    loss_c, grads_c, spec = loss_and_grads(composed, "seq")
+    loss_s, grads_s, _ = loss_and_grads(single, None)
+
+    # Experts really shard over the model axis on the composed mesh.
+    assert "model" in spec, spec
+    np.testing.assert_allclose(loss_c, loss_s, rtol=2e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads_c),
+        jax.tree_util.tree_leaves(grads_s),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+        )
+
+
+def test_pp_train_step_with_moe_layers():
+    """pp x tp x ep: the 1F1B pipeline step trains a MoE transformer on a
+    party x stage x model mesh (experts over the model axis)."""
+    from rayfed_tpu.parallel.pipeline import make_pp_train_step
+
+    cfg = tfm.tiny_config(
+        n_layers=4, n_experts=4, compute_dtype=jnp.float32
+    )
+    mesh = Mesh(
+        np.array(jax.devices()[:8]).reshape(2, 2, 2),
+        ("party", "stage", "model"),
+    )
+    init_fn, step_fn = make_pp_train_step(
+        cfg, mesh, party_axis="party", n_microbatches=4, schedule="1f1b",
+        lr=1e-2,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 17), 0, cfg.vocab)
+    params, opt_state = init_fn(jax.random.PRNGKey(0), tokens[:, :-1])
+    spec = tuple(params["layers"]["moe"]["w_up"].sharding.spec)
+    assert "model" in spec, spec
+    l0 = None
+    for i in range(3):
+        params, opt_state, loss = step_fn(
+            params, opt_state, tokens[:, :-1], tokens[:, 1:]
+        )
+        if i == 0:
+            l0 = float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < l0
