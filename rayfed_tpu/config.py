@@ -137,6 +137,17 @@ class CrossSiloMessageConfig:
     # lane ignores it (the reference wire has no such field).
     payload_compression: Optional[str] = None
     compression_level: int = 1
+    # Device-DMA data plane on the TPU transport (opt-in): all-jax-Array
+    # payloads are pulled device-to-device through a per-party
+    # jax.experimental.transfer server; the ordinary socket frame carries
+    # only a descriptor (uuid + server address + avals). Non-array or
+    # sharded-leaf payloads, and every frame when the server cannot
+    # start, ride the socket lane unchanged. ``dma_listen_addr`` is the
+    # bind address ("host:0" picks a free port); the advertised address
+    # keeps the bound host, so cross-host deployments must bind a
+    # peer-reachable interface, not loopback.
+    device_dma: bool = False
+    dma_listen_addr: str = "127.0.0.1:0"
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False

@@ -219,8 +219,22 @@ class _DestWorker(threading.Thread):
         else:
             value = data
 
-        kind, meta, buffers = serialization.encode_payload(value)
         cfg = self._cfg
+        special = self._proxy._try_encode_special(value, is_error, cfg)
+        if special is not None:
+            kind, payload, on_done = special
+            header = {
+                "job": self._proxy._job_name,
+                "src": self._proxy._party,
+                "up": str(upstream_seq_id),
+                "down": str(downstream_seq_id),
+                "is_error": bool(is_error),
+                "pkind": kind,
+                "pmeta": b"",
+            }
+            return header, [payload], len(payload), on_done
+
+        kind, meta, buffers = serialization.encode_payload(value)
         if kind == "pickle" and not cfg.allow_pickle_payloads and not is_error:
             raise ValueError(
                 "payload requires pickling but allow_pickle_payloads=False "
@@ -316,6 +330,12 @@ class TcpSenderProxy(SenderProxy):
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._stats = {"send_op_count": 0}
+
+    def _try_encode_special(self, value, is_error: bool, cfg):
+        """Subclass hook: divert a payload to an alternate lane. Returns
+        (pkind, payload_bytes) or None for the standard encode path (the
+        TPU transport's device-DMA descriptor frames plug in here)."""
+        return None
 
     def _bump_stat(self, key: str) -> None:
         # += on a dict value is not atomic across worker/reader threads.

@@ -1,0 +1,233 @@
+"""Device-DMA data plane on ``jax.experimental.transfer`` (prototype).
+
+The final leg of the BASELINE.json north star ("cross-party push via
+device-to-device transfer"): instead of staging device arrays through
+host bytes on the socket lane (``serialization.try_encode_tree`` →
+``sockio`` → ``device_put``), the sender parks the live device buffers on
+a per-process PJRT transfer server (``await_pull``) and ships only a
+tiny descriptor frame over the existing control/data plane; the receiver
+pulls the buffers device-to-device (``TransferConnection.pull``). On a
+TPU pod the engine rides ICI/DCN; in CPU simulation it uses its socket
+bulk transport (explicit ``transport_addresses`` — the same-host "local"
+bulk path in jaxlib 0.9 is broken across OS processes, so we always pin
+the socket transport).
+
+Semantics notes (measured, see tests):
+ - ``await_pull`` pins the arrays internally — the sender may drop its
+   references immediately.
+ - A uuid is pullable exactly ONCE; the rendezvous store's
+   deliver-once-per-edge guarantee (duplicates acked-and-dropped) is
+   what makes this safe.
+ - A descriptor whose sender died is a hung ``pull`` — the lane is
+   opt-in (``device_dma: true``) and cross-party failure detection stays
+   on the control plane (error envelopes / recv deadlines).
+
+Reference parity anchor: this replaces the reference's only data plane
+(one gRPC unary per object, ``fed/proxy/grpc/grpc_proxy.py:193-220``)
+for the device-resident case; descriptor rendezvous keys are unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_server = None
+_server_addr: Optional[str] = None
+_server_failed: Optional[str] = None
+_uuid_counter = None
+_connections: Dict[str, object] = {}
+
+# Failed-send leak bound: a registered uuid whose descriptor frame never
+# reached the peer is never pulled, and the transfer API has no unpin —
+# those buffers stay pinned for the process's life. Each failed send adds
+# its bytes here; past the cap the lane disables itself (socket fallback)
+# instead of pinning toward an OOM. Successful sends are presumed pulled
+# (delivery -> rendezvous decode pulls exactly once).
+_failed_pinned_bytes = 0
+_FAILED_PINNED_CAP = 1 << 30
+
+
+_sender_disabled: Optional[str] = None
+
+
+def note_send_result(nbytes: int, ok: bool) -> None:
+    """Sender-side accounting hook: called when a dma descriptor send
+    resolves. Failures accumulate pinned bytes; past the cap the lane's
+    SENDER side shuts off for this process (receiving/pulling still
+    works)."""
+    global _failed_pinned_bytes, _sender_disabled
+    if ok:
+        return
+    with _lock:
+        _failed_pinned_bytes += nbytes
+        if _failed_pinned_bytes > _FAILED_PINNED_CAP and _sender_disabled is None:
+            _sender_disabled = (
+                f"{_failed_pinned_bytes} bytes pinned by failed sends "
+                f"(cap {_FAILED_PINNED_CAP})"
+            )
+            logger.warning(
+                "device-DMA sender disabled: %s — pushes use the socket "
+                "lane from now on.", _sender_disabled,
+            )
+
+
+def _advertised_addr(bound: str, listen_host: str) -> str:
+    """The address peers should connect to: the transfer server reports
+    its bound port on a wildcard host; substitute the configured host."""
+    port = bound.rsplit(":", 1)[1]
+    return f"{listen_host}:{port}"
+
+
+def get_transfer_server(listen_addr: str = "127.0.0.1:0"):
+    """The process-wide transfer server (lazy; one per process), or None
+    when unavailable on this backend — callers then use the socket lane."""
+    global _server, _server_addr, _server_failed, _uuid_counter
+    with _lock:
+        if _server is not None:
+            return _server, _server_addr
+        if _server_failed is not None:
+            return None, None
+        try:
+            import random
+
+            import jax
+            from jax.experimental import transfer
+
+            host = listen_addr.rsplit(":", 1)[0]
+            client = jax.local_devices()[0].client
+            # Explicit transport_addresses pin the socket bulk transport
+            # (the implicit same-host "local" transport CHECK-fails
+            # across OS processes in jaxlib 0.9).
+            _server = transfer.start_transfer_server(
+                client, listen_addr, [f"{host}:0"]
+            )
+            _server_addr = _advertised_addr(_server.address(), host)
+            # uuids are scoped to this server; the random base keeps
+            # repeat fed.init() in one process from reusing ids.
+            _uuid_counter = itertools.count(random.getrandbits(30) << 20)
+        except Exception as e:  # noqa: BLE001 - degrade to socket lane
+            _server_failed = str(e)
+            logger.warning(
+                "device-DMA transfer server unavailable (%s); pushes use "
+                "the socket lane.", e,
+            )
+            return None, None
+        return _server, _server_addr
+
+
+def try_register(value, listen_addr: str) -> Optional[Tuple[Dict, bytes]]:
+    """If ``value`` is a pytree of single-device jax.Arrays, park its
+    leaves on the transfer server and return (header_fields, descriptor,
+    on_done) for a ``dma`` frame (``on_done(ok)`` feeds the failed-send
+    leak accounting); else None (socket lane)."""
+    import jax
+
+    if _sender_disabled is not None:
+        return None
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    if not leaves:
+        return None
+    for leaf in leaves:
+        if not isinstance(leaf, jax.Array):
+            return None
+        if not leaf.is_fully_addressable or len(leaf.sharding.device_set) != 1:
+            # Multi-device leaves still ride the sharded wire format.
+            return None
+    server, addr = get_transfer_server(listen_addr)
+    if server is None:
+        return None
+    # The engine's own pytree (wire-encodable TreeSpec, the same form the
+    # tree lane ships); jax trees of dict/list/tuple flatten identically.
+    from rayfed_tpu import tree_util as rtree
+    from rayfed_tpu._private import serialization
+
+    rleaves, rspec = rtree.tree_flatten(value)
+    wire_spec = serialization._spec_to_wire(rspec)
+    if wire_spec is None or len(rleaves) != len(leaves):
+        return None  # structure jax flattens but our pytree cannot ship
+    uuid = next(_uuid_counter)
+    server.await_pull(uuid, rleaves)  # pins the buffers until pulled
+    nbytes = sum(x.nbytes for x in rleaves)
+    payload = msgpack.packb(
+        {
+            "uuid": uuid,
+            "addr": addr,
+            "spec": wire_spec,
+            "leaves": [
+                {"shape": list(x.shape), "dtype": str(x.dtype)}
+                for x in rleaves
+            ],
+        },
+        use_bin_type=True,
+    )
+
+    def on_done(ok: bool) -> None:
+        note_send_result(nbytes, ok)
+
+    return {"pkind": "dma"}, payload, on_done
+
+
+def pull(meta_payload, listen_addr: str = "127.0.0.1:0",
+         max_bytes: Optional[int] = None):
+    """Receiver side: connect to the sender's transfer server and pull
+    the buffers onto local devices; returns the reassembled pytree.
+
+    The descriptor's declared sizes are validated against ``max_bytes``
+    (the receiver's payload cap) BEFORE any allocation — a hostile
+    descriptor cannot OOM the receiver any more than an oversized socket
+    frame can."""
+    import math
+
+    import jax
+    import numpy as np
+
+    from rayfed_tpu import tree_util as rtree
+    from rayfed_tpu._private import serialization
+
+    desc = msgpack.unpackb(bytes(meta_payload), raw=False)
+    addr = desc["addr"]
+    total = 0
+    for e in desc["leaves"]:
+        total += int(math.prod(e["shape"])) * np.dtype(e["dtype"]).itemsize
+    if max_bytes is not None and total > max_bytes:
+        raise ValueError(
+            f"dma descriptor declares {total} bytes, exceeding the "
+            f"receiver's payload cap ({max_bytes})"
+        )
+    server, _ = get_transfer_server(listen_addr)
+    if server is None:
+        raise RuntimeError(
+            "received a dma frame but no local transfer server is "
+            "available (set device_dma on every party, or unset it on "
+            "the sender)"
+        )
+    with _lock:
+        conn = _connections.get(addr)
+        if conn is None:
+            conn = _connections[addr] = server.connect(addr)
+    dev = jax.local_devices()[0]
+    sharding = jax.sharding.SingleDeviceSharding(dev)
+    sds: List = [
+        jax.ShapeDtypeStruct(
+            tuple(e["shape"]), np.dtype(e["dtype"]), sharding=sharding
+        )
+        for e in desc["leaves"]
+    ]
+    leaves = conn.pull(desc["uuid"], sds)
+    spec = serialization._spec_from_wire(desc["spec"])
+    return rtree.tree_unflatten(list(leaves), spec)
+
+
+def reset() -> None:
+    """Drop cached connections (test hygiene; the server itself is
+    process-wide and stays up — PJRT servers are not restartable)."""
+    with _lock:
+        _connections.clear()

@@ -27,18 +27,45 @@ logger = logging.getLogger(__name__)
 class TpuSenderProxy(TcpSenderProxy):
     """Sender side: identical wire behavior; arrays (jax or numpy) ride the
     zero-pickle tree encoding. Device→host staging happens in the encode
-    worker (``np.asarray`` on a jax.Array) off the event loop."""
+    worker (``np.asarray`` on a jax.Array) off the event loop.
+
+    With ``device_dma: true`` in the comm config, all-jax-Array payloads
+    skip host staging entirely: the buffers are parked on this process's
+    transfer server and only a descriptor frame crosses the socket (see
+    :mod:`rayfed_tpu.proxy.tpu.dma`)."""
+
+    def _try_encode_special(self, value, is_error: bool, cfg):
+        if is_error or not getattr(cfg, "device_dma", False):
+            return None
+        from rayfed_tpu.proxy.tpu import dma
+
+        reg = dma.try_register(value, cfg.dma_listen_addr)
+        if reg is None:
+            return None  # not all-array / server unavailable -> socket lane
+        header_fields, payload = reg
+        return header_fields["pkind"], payload
 
 
 def _device_placer(allowed_list, allow_pickle: bool = True,
-                   max_decompressed_bytes=None):
+                   max_decompressed_bytes=None, device_dma: bool = False,
+                   dma_listen_addr: str = "127.0.0.1:0"):
     base = rendezvous.default_decode(
         allowed_list, allow_pickle=allow_pickle, sharded_fn=place_sharded,
         max_decompressed_bytes=max_decompressed_bytes,
     )
 
     def decode(header, payload):
-        value = base(header, payload)
+        if header.get("pkind") == "dma":
+            if not device_dma:
+                raise ValueError(
+                    "received a device-DMA frame but device_dma is not "
+                    "enabled on this party's comm config"
+                )
+            from rayfed_tpu.proxy.tpu import dma
+
+            value = dma.pull(payload, dma_listen_addr)
+        else:
+            value = base(header, payload)
         mesh = _party_mesh()
         if mesh is None:
             return value
@@ -148,4 +175,8 @@ class TpuReceiverProxy(TcpReceiverProxy):
             self._config.serializing_allowed_list,
             allow_pickle=self._config.allow_pickle_payloads,
             max_decompressed_bytes=self._config.effective_max_message_bytes(),
+            device_dma=getattr(self._config, "device_dma", False),
+            dma_listen_addr=getattr(
+                self._config, "dma_listen_addr", "127.0.0.1:0"
+            ),
         )

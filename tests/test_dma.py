@@ -1,0 +1,139 @@
+"""Device-DMA lane (VERDICT r2 #3): two OS-process parties exchange
+all-jax-Array payloads through ``jax.experimental.transfer`` — only a
+descriptor frame crosses the socket; buffers move device-to-device via
+the transfer engine's bulk transport. Bitwise equality both ways, plus
+graceful fallback to the socket lane for non-array payloads and when the
+feature is off."""
+
+import numpy as np
+
+from tests.utils import FAST_COMM_CONFIG, run_parties
+
+
+def _dma_party(party, addresses):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.proxy.tpu import dma
+
+    comm = dict(FAST_COMM_CONFIG)
+    comm["device_dma"] = True
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": comm, "transport": "tpu"},
+    )
+
+    @fed.remote
+    def produce():
+        return {
+            "w": jnp.arange(1 << 18, dtype=jnp.float32) * 0.5,
+            "b": (jnp.ones((64, 64), jnp.bfloat16), jnp.int32(7)),
+        }
+
+    @fed.remote
+    def consume(tree):
+        assert isinstance(tree["w"], jax.Array), type(tree["w"])
+        assert tree["b"][0].dtype == jnp.bfloat16
+        return (
+            float(tree["w"].sum())
+            + float(tree["b"][0].astype(jnp.float32).sum())
+            + int(tree["b"][1])
+        )
+
+    out = consume.party("bob").remote(produce.party("alice").remote())
+    got = fed.get(out)
+    expect = float(np.arange(1 << 18, dtype=np.float32).sum() * 0.5) + 64 * 64 + 7
+    assert got == expect, (got, expect)
+
+    if party == "alice":
+        # The descriptor lane really ran: the transfer server came up on
+        # the producing side (registration happened there).
+        assert dma._server is not None
+    else:
+        # ...and the consumer pulled through a cached connection.
+        assert dma._connections, "no DMA connection was opened"
+
+    # Mixed payload (string leaf) falls back to the socket lane on the
+    # same transport, same config.
+    @fed.remote
+    def produce_mixed():
+        return {"tag": "hello", "x": jnp.zeros(4)}
+
+    @fed.remote
+    def consume_mixed(tree):
+        return tree["tag"]
+
+    assert fed.get(
+        consume_mixed.party("alice").remote(produce_mixed.party("bob").remote())
+    ) == "hello"
+    fed.shutdown()
+
+
+def test_two_party_dma_push():
+    run_parties(_dma_party, ["alice", "bob"], timeout=240)
+
+
+def _dma_off_party(party, addresses):
+    import jax.numpy as jnp
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.proxy.tpu import dma
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(FAST_COMM_CONFIG), "transport": "tpu"},
+    )
+
+    @fed.remote
+    def produce():
+        return jnp.arange(1024.0)
+
+    @fed.remote
+    def consume(x):
+        return float(x[-1])
+
+    assert fed.get(consume.party("bob").remote(produce.party("alice").remote())) == 1023.0
+    assert dma._server is None  # feature off -> no transfer server
+    fed.shutdown()
+
+
+def test_dma_disabled_stays_on_socket_lane():
+    run_parties(_dma_off_party, ["alice", "bob"], timeout=240)
+
+
+def test_dma_roundtrip_single_process():
+    """Register + pull within one process (loopback connection): pytree
+    structure, dtypes, and bytes survive; numpy-leaf trees are refused
+    (socket lane's job)."""
+    import jax.numpy as jnp
+
+    from rayfed_tpu.config import TcpCrossSiloMessageConfig
+    from rayfed_tpu.proxy.tpu import dma
+
+    cfg = TcpCrossSiloMessageConfig.from_dict({"device_dma": True})
+    assert cfg.device_dma is True
+
+    tree = {
+        "a": jnp.arange(4096, dtype=jnp.int32),
+        "nest": [jnp.full((8, 3), 2.5), (jnp.float32(1.5),)],
+    }
+    reg = dma.try_register(tree, cfg.dma_listen_addr)
+    assert reg is not None
+    header_fields, payload = reg
+    assert header_fields["pkind"] == "dma"
+    assert len(payload) < 4096  # descriptor, not data
+
+    out = dma.pull(payload, cfg.dma_listen_addr)
+    assert isinstance(out, dict) and isinstance(out["nest"], list)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4096))
+    np.testing.assert_array_equal(
+        np.asarray(out["nest"][0]), np.full((8, 3), 2.5, np.float32)
+    )
+    assert float(out["nest"][1][0]) == 1.5
+
+    # numpy-leaf payloads are not DMA-able (host memory): socket lane.
+    assert dma.try_register({"x": np.zeros(4)}, cfg.dma_listen_addr) is None
