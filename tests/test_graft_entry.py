@@ -52,6 +52,13 @@ def test_dryrun_multichip_under_driver_conditions():
     )
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "dryrun_multichip OK" in proc.stdout, proc.stdout
+    # The optional sections degrade to "<name> section skipped: ..." on
+    # backends that lack them — the CPU sim has them all, so a skip here
+    # is a regression (round-3 failure mode: the dma section crashed on a
+    # try_register signature change and the dryrun still said OK).
+    assert "section skipped" not in proc.stdout, proc.stdout
+    assert "dma(pull=True)" in proc.stdout, proc.stdout
+    assert "decode(tp-sharded=True)" in proc.stdout, proc.stdout
 
 
 def test_entry_compiles_and_runs():
