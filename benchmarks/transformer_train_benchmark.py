@@ -53,6 +53,9 @@ def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
     from rayfed_tpu.parallel.train import make_fed_train_step
 
     on_tpu = jax.default_backend() == "tpu"
+    # Progress marker: a supervising process (bench.py's watchdog) reads
+    # this to distinguish "wedged accelerator" from "long XLA compile".
+    print(f"BACKEND_UP {jax.default_backend()}", flush=True)
     if remat is None:
         remat = on_tpu  # memory-for-FLOPs is the right default on the chip
 
@@ -81,8 +84,10 @@ def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
     # matmul — excluded from the 6N FLOPs term (lm_head stays in).
     n_matmul_params = n_params - params["embed"].size
     # Warmup/compile.
+    t_c = time.perf_counter()
     params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
     float(loss)
+    print(f"COMPILED {time.perf_counter() - t_c:.1f}s", flush=True)
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, loss = step_fn(params, opt_state, inputs, targets)
