@@ -1,3 +1,17 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
 """Test configuration.
 
 Mirrors the reference's test recipe (SURVEY.md §4): multi-party tests spawn
@@ -37,6 +51,7 @@ _SLOW_TESTS = {
     "test_dryrun_multichip_under_driver_conditions",
     "test_federated_lora_round",
     "test_1f1b_loss_and_grads_match_gpipe",
+    "test_1f1b_temp_memory_flat_while_gpipe_grows",
     "test_federated_cnn_two_party",
     "test_pp_train_step_composes_party_stage_model",
     "test_1f1b_composes_with_tp_and_party",

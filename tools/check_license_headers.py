@@ -1,0 +1,100 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""License-header checker/fixer for Python sources.
+
+Capability parity: the reference CI runs license-header-checker over its
+tree (``.github/workflows/license-checker.yml``). This is a dependency-free
+equivalent: ``python tools/check_license_headers.py`` lists offending
+files (exit 1 if any), ``--fix`` inserts the header from
+``license_header.txt`` after an optional shebang/coding line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+SKIP_DIRS = {
+    ".git", "__pycache__", "build", ".jax_cache", ".pytest_cache",
+    "docs", ".github", ".venv", "venv", "env", ".tox", "node_modules",
+    ".eggs", "dist",
+}
+MARKER = "Licensed under the Apache License"
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def has_header(path: str) -> bool:
+    with open(path, encoding="utf-8") as f:
+        head = f.read(2048)
+    return MARKER in head
+
+
+def insert_header(path: str, header: str) -> None:
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    idx = 0
+    # Keep shebang and PEP 263 coding declarations (comment lines only)
+    # at the very top.
+    coding = re.compile(r"^#.*coding[:=]\s*[-\w.]+")
+    while idx < len(lines) and (
+        lines[idx].startswith("#!") or coding.match(lines[idx])
+    ):
+        idx += 1
+    block = header.rstrip("\n") + "\n\n"
+    lines.insert(idx, block)
+    with open(path, "w", encoding="utf-8") as f:
+        f.writelines(lines)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fix", action="store_true",
+                        help="insert the header into offending files")
+    parser.add_argument("--root", default=None,
+                        help="tree to scan (default: repo root)")
+    args = parser.parse_args()
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    header_path = os.path.join(root, "license_header.txt")
+    with open(header_path, encoding="utf-8") as f:
+        header = f.read()
+    missing = [p for p in iter_py_files(root) if not has_header(p)]
+    if not missing:
+        print("license headers: all files OK")
+        return 0
+    for path in sorted(missing):
+        print(os.path.relpath(path, root))
+        if args.fix:
+            insert_header(path, header)
+    if args.fix:
+        print(f"license headers: fixed {len(missing)} files")
+        return 0
+    print(f"license headers: {len(missing)} files missing "
+          f"(run with --fix to insert)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
