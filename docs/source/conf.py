@@ -1,0 +1,56 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Sphinx configuration (capability parity: reference
+``docs/source/conf.py``). The hand-written guides live as Markdown one
+level up (``docs/*.md``); this tree renders them via myst-parser plus
+autodoc API pages. Build: ``pip install sphinx myst-parser &&
+sphinx-build -b html docs/source docs/_build``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join("..", "..")))
+
+project = "rayfed-tpu"
+copyright = "2026, The rayfed-tpu Authors"
+author = "The rayfed-tpu Authors"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "myst_parser",
+]
+
+# The markdown guides live in docs/ (one level above this source tree);
+# include them without duplication.
+import shutil  # noqa: E402
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_guides = os.path.join(_here, "guides")
+# Rebuild the staging dir from scratch (gitignored): a stale copy of a
+# renamed/deleted guide must not keep rendering.
+shutil.rmtree(_guides, ignore_errors=True)
+os.makedirs(_guides)
+for _name in os.listdir(os.path.join(_here, "..")):
+    if _name.endswith(".md"):
+        shutil.copy(os.path.join(_here, "..", _name),
+                    os.path.join(_guides, _name))
+
+source_suffix = {".rst": "restructuredtext", ".md": "markdown"}
+master_doc = "index"
+exclude_patterns = ["_build"]
+html_theme = "alabaster"

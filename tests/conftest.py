@@ -52,6 +52,7 @@ _SLOW_TESTS = {
     "test_federated_lora_round",
     "test_1f1b_loss_and_grads_match_gpipe",
     "test_1f1b_temp_memory_flat_while_gpipe_grows",
+    "test_split_learning_notebook_executes",
     "test_federated_cnn_two_party",
     "test_pp_train_step_composes_party_stage_model",
     "test_1f1b_composes_with_tp_and_party",
