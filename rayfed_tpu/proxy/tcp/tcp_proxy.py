@@ -196,12 +196,23 @@ class _DestWorker(threading.Thread):
                 return
             out, data, upstream_seq_id, downstream_seq_id, is_error = job
             try:
-                header, buffers, payload_len = self._prepare(
+                header, buffers, payload_len, on_done = self._prepare(
                     data, upstream_seq_id, downstream_seq_id, is_error
                 )
             except BaseException as e:  # noqa: BLE001 - routed to drain
                 out.set_exception(e)
                 continue
+            if on_done is not None:
+                # Alternate-lane accounting hook (device-DMA failed-send
+                # leak bound): tell the lane whether the descriptor frame
+                # was actually delivered.
+                def _notify(f, cb=on_done):
+                    try:
+                        cb(f.exception() is None and f.result() is True)
+                    except Exception:  # noqa: BLE001 - accounting only
+                        logger.exception("send on_done callback failed")
+
+                out.add_done_callback(_notify)
             if tracing.is_enabled():
                 t0 = time.perf_counter()
                 nbytes = payload_len
@@ -280,7 +291,7 @@ class _DestWorker(threading.Thread):
                 header["rawlen"] = raw_len
                 buffers = [blob]
                 payload_len = len(blob)
-        return header, buffers, payload_len
+        return header, buffers, payload_len, None
 
     def _send_half_duplex(self, header, buffers) -> bool:
         # TLS path. Send with bounded reconnect: first attempt gets the
@@ -347,8 +358,10 @@ class TcpSenderProxy(SenderProxy):
 
     def _try_encode_special(self, value, is_error: bool, cfg):
         """Subclass hook: divert a payload to an alternate lane. Returns
-        (pkind, payload_bytes) or None for the standard encode path (the
-        TPU transport's device-DMA descriptor frames plug in here)."""
+        (pkind, payload_bytes, on_done) — ``on_done(ok: bool)`` is called
+        when the send future resolves, for lane-side accounting — or None
+        for the standard encode path (the TPU transport's device-DMA
+        descriptor frames plug in here)."""
         return None
 
     def _bump_stat(self, key: str) -> None:

@@ -46,7 +46,7 @@ from __future__ import annotations
 import itertools
 import logging
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
@@ -137,7 +137,9 @@ def get_transfer_server(listen_addr: str = "127.0.0.1:0"):
         return _server, _server_addr
 
 
-def try_register(value, listen_addr: str) -> Optional[Tuple[Dict, bytes]]:
+def try_register(
+    value, listen_addr: str
+) -> Optional[Tuple[Dict, bytes, Callable[[bool], None]]]:
     """If ``value`` is a pytree of single-device jax.Arrays, park its
     leaves on the transfer server and return (header_fields, descriptor,
     on_done) for a ``dma`` frame (``on_done(ok)`` feeds the failed-send

@@ -56,8 +56,8 @@ class TpuSenderProxy(TcpSenderProxy):
         reg = dma.try_register(value, cfg.dma_listen_addr)
         if reg is None:
             return None  # not all-array / server unavailable -> socket lane
-        header_fields, payload = reg
-        return header_fields["pkind"], payload
+        header_fields, payload, on_done = reg
+        return header_fields["pkind"], payload, on_done
 
 
 def _device_placer(allowed_list, allow_pickle: bool = True,
@@ -77,7 +77,11 @@ def _device_placer(allowed_list, allow_pickle: bool = True,
                 )
             from rayfed_tpu.proxy.tpu import dma
 
-            value = dma.pull(payload, dma_listen_addr)
+            # The receiver's payload cap applies to declared DMA sizes
+            # too: a tiny descriptor must not be able to command a huge
+            # allocation (dma.pull validates before allocating).
+            value = dma.pull(payload, dma_listen_addr,
+                             max_bytes=max_decompressed_bytes)
         else:
             value = base(header, payload)
         mesh = _party_mesh()

@@ -137,8 +137,9 @@ def test_dma_roundtrip_single_process():
     }
     reg = dma.try_register(tree, cfg.dma_listen_addr)
     assert reg is not None
-    header_fields, payload = reg
+    header_fields, payload, on_done = reg
     assert header_fields["pkind"] == "dma"
+    assert callable(on_done)
     assert len(payload) < 4096  # descriptor, not data
 
     out = dma.pull(payload, cfg.dma_listen_addr)
@@ -151,3 +152,32 @@ def test_dma_roundtrip_single_process():
 
     # numpy-leaf payloads are not DMA-able (host memory): socket lane.
     assert dma.try_register({"x": np.zeros(4)}, cfg.dma_listen_addr) is None
+
+
+def test_dma_receiver_rejects_oversized_descriptor():
+    """A tiny descriptor frame must not be able to command a huge
+    allocation: the receiver's payload cap applies to the DECLARED leaf
+    sizes before anything is allocated or pulled."""
+    import msgpack
+    import pytest
+
+    from rayfed_tpu.proxy.tpu import dma
+    from rayfed_tpu.proxy.tpu.tpu_proxy import _device_placer
+
+    hostile = msgpack.packb(
+        {
+            "uuid": 1,
+            "addr": "127.0.0.1:1",
+            "spec": {"t": "leaf"},
+            "leaves": [{"shape": [1 << 20, 1 << 20], "dtype": "float32"}],
+        },
+        use_bin_type=True,
+    )
+    # Direct pull honors max_bytes before allocating.
+    with pytest.raises(ValueError, match="payload cap"):
+        dma.pull(hostile, "127.0.0.1:0", max_bytes=1 << 20)
+    # And the receiver's decode path passes its cap through.
+    decode = _device_placer([], device_dma=True,
+                            max_decompressed_bytes=1 << 20)
+    with pytest.raises(ValueError, match="payload cap"):
+        decode({"pkind": "dma"}, hostile)
