@@ -48,3 +48,8 @@ CODE_FORBIDDEN = 403  # peer cert does not attest the claimed src party
 CODE_PICKLE_FORBIDDEN = 415  # strict arrays-only mode rejected the frame
 CODE_JOB_MISMATCH = 417
 CODE_INTERNAL_ERROR = 500
+
+# Seq id used by the ping_others readiness barrier for both the upstream
+# and downstream ids of a ping send — matches the reference's literal
+# "ping"/"ping" pair on the wire (ref fed/proxy/barriers.py:497-523).
+PING_SEQ_ID = "ping"

@@ -115,6 +115,13 @@ class SenderReceiverProxy(abc.ABC):
     def get_stats(self) -> Dict:
         return {}
 
+    def ping_sources(self):
+        """(attributed ping sources, anonymous ping count) seen by this
+        receiver, or None when this backend's wire can never attribute
+        pings — the readiness barrier then skips its mutual wait instead
+        of burning the grace period on every init."""
+        return None
+
     def stop(self) -> None:  # pragma: no cover - trivial default
         pass
 
@@ -151,6 +158,13 @@ class ReceiverProxy(abc.ABC):
 
     def get_stats(self) -> Dict:
         return {}
+
+    def ping_sources(self):
+        """(attributed ping sources, anonymous ping count) seen by this
+        receiver, or None when this backend's wire can never attribute
+        pings — the readiness barrier then skips its mutual wait instead
+        of burning the grace period on every init."""
+        return None
 
     def stop(self) -> None:  # pragma: no cover - trivial default
         pass

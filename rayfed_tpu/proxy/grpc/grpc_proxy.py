@@ -284,6 +284,11 @@ class GrpcReceiverProxy(ReceiverProxy):
     def get_stats(self) -> Dict:
         return self._store.get_stats()
 
+    def ping_sources(self):
+        # The reference-compatible wire has no src field: pings can never
+        # be attributed, so the barrier must not wait on mutuality.
+        return None
+
     def stop(self) -> None:
         if self._server is not None:
             self._server.stop(grace=0.5)

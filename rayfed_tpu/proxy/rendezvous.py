@@ -38,6 +38,7 @@ from rayfed_tpu._private.constants import (
     CODE_JOB_MISMATCH,
     CODE_OK,
     CODE_PICKLE_FORBIDDEN,
+    PING_SEQ_ID,
 )
 
 logger = logging.getLogger(__name__)
@@ -105,6 +106,12 @@ class RendezvousStore:
             max_workers=decode_workers, thread_name_prefix="fedtpu-recv-decode"
         )
         self._stats = {"receive_op_count": 0}
+        # Readiness-ping bookkeeping (barrier mutuality): which peers
+        # have pinged this receiver, by the header's src when the lane
+        # carries one; pings on the reference-compatible gRPC wire have
+        # no src field and are counted anonymously.
+        self._ping_srcs: set = set()
+        self._anon_pings = 0
         self._stopped = False
         self._deadlines: Dict[Tuple[str, str], float] = {}
         if recv_timeout_s is not None:
@@ -160,6 +167,21 @@ class RendezvousStore:
                 CODE_JOB_MISMATCH,
                 f"job name mismatch: got {job!r}, expected {self._job_name!r}",
             )
+        key = (header["up"], header["down"])
+        if key == (PING_SEQ_ID, PING_SEQ_ID):
+            # Readiness pings are acked and recorded, never stored or
+            # decoded: no consumer ever takes them (so size/pickle policy
+            # is moot), and the barrier needs to know WHO pinged
+            # (ping_others mutuality — a party must not pass its barrier
+            # and tear down while a peer has not reached it yet).
+            with self._lock:
+                self._stats["receive_op_count"] += 1
+                src = header.get("src") or ""
+                if src:
+                    self._ping_srcs.add(src)
+                else:
+                    self._anon_pings += 1
+            return CODE_OK, "ping"
         nbytes = serialization.payload_nbytes(payload)
         if self._max_payload_bytes is not None and nbytes > self._max_payload_bytes:
             return (
@@ -178,7 +200,6 @@ class RendezvousStore:
                 CODE_PICKLE_FORBIDDEN,
                 "pickle payloads are disabled (allow_pickle_payloads=False)",
             )
-        key = (header["up"], header["down"])
         with self._lock:
             self._stats["receive_op_count"] += 1
             if key in self._consumed:
@@ -248,6 +269,12 @@ class RendezvousStore:
     def get_stats(self) -> Dict:
         with self._lock:
             return dict(self._stats)
+
+    def ping_sources(self) -> Tuple[set, int]:
+        """(attributed ping sources, anonymous ping count) — consumed by
+        the ``ping_others`` mutual-readiness barrier."""
+        with self._lock:
+            return set(self._ping_srcs), self._anon_pings
 
     def shutdown(self) -> None:
         self._stopped = True

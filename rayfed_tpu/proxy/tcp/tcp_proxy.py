@@ -68,8 +68,9 @@ logger = logging.getLogger(__name__)
 
 
 def _parse_addr(addr: str) -> Tuple[str, int]:
-    host, port = addr.rsplit(":", 1)
-    return host, int(port)
+    from rayfed_tpu.utils import parse_address
+
+    return parse_address(addr)
 
 
 class _DestWorker(threading.Thread):
@@ -459,6 +460,9 @@ class TcpReceiverProxy(ReceiverProxy):
     def get_stats(self) -> Dict:
         return self._store.get_stats()
 
+    def ping_sources(self):
+        return self._store.ping_sources()
+
     def stop(self) -> None:
         self._stopping = True
         if self._listener is not None:
@@ -651,6 +655,9 @@ class TcpSenderReceiverProxy(SenderReceiverProxy):
 
     def get_stats(self) -> Dict:
         return {**self._sender.get_stats(), **self._receiver.get_stats()}
+
+    def ping_sources(self):
+        return self._receiver.ping_sources()
 
     def stop(self) -> None:
         self._sender.stop()

@@ -108,6 +108,14 @@ def setup_logger(
 _ADDR_RE = re.compile(r"^(?P<host>[^:/ ]+):(?P<port>\d{1,5})$")
 
 
+def parse_address(address: str) -> "tuple[str, int]":
+    """Split a validated ``host:port`` into its parts — the one place
+    the accepted address format is interpreted (transports and the
+    readiness probe must agree on it)."""
+    host, port = address.rsplit(":", 1)
+    return host, int(port)
+
+
 def validate_address(address: str) -> None:
     """Accept ``host:port`` or ``hostname:port``; reject schemes and
     malformed ports (behavioral contract of ref ``fed/utils.py:198-239``,
