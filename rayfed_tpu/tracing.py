@@ -107,6 +107,45 @@ def summary() -> Dict[str, Dict]:
     return out
 
 
+def export_chrome_trace(path: str, party: str = "") -> int:
+    """Write recorded spans as a Chrome/Perfetto trace-event JSON file
+    (open in ``chrome://tracing`` or ``ui.perfetto.dev``). Timed kinds
+    become complete ("X") events on a per-kind track; event kinds (e.g.
+    "recv" arrivals) become instant ("i") events. Returns the number of
+    events written. Complements ``jax.profiler`` captures: this is the
+    engine-side wire timeline, device timelines come from the profiler.
+    """
+    import json
+
+    events = []
+    pid = party or "rayfed_tpu"
+    for s in get_spans():
+        base = {
+            "name": f"{s.kind} {s.peer}".strip(),
+            "cat": s.kind,
+            "pid": pid,
+            "tid": s.kind,
+            "ts": s.start_s * 1e6,  # microseconds
+            "args": {
+                "up": s.upstream_seq_id,
+                "down": s.downstream_seq_id,
+                "nbytes": s.nbytes,
+                "ok": s.ok,
+                **s.extra,
+            },
+        }
+        if s.kind in _TIMED_KINDS:
+            base["ph"] = "X"
+            base["dur"] = max(s.duration_s, 1e-7) * 1e6
+        else:
+            base["ph"] = "i"
+            base["s"] = "t"
+        events.append(base)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
 def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
            nbytes: int, start_s: float, ok: bool = True) -> None:
     """Directly append a span (for async paths where a context manager

@@ -125,6 +125,36 @@ def test_tracing_spans_record_transfers():
         tracing.clear()
 
 
+def test_chrome_trace_export(tmp_path):
+    """Spans export as a valid Chrome trace-event file: timed kinds as
+    complete events with durations, arrivals as instants."""
+    import json
+
+    tracing.clear()
+    tracing.enable()
+    try:
+        sp, rp = _pair()
+        fut = rp.get_data("alice", "9#0", 7)
+        assert sp.send("bob", {"g": np.zeros(256, np.float32)}, "9#0", 7
+                       ).result(timeout=30)
+        fut.result(timeout=30)
+        sp.stop()
+        rp.stop()
+        out = tmp_path / "trace.json"
+        n = tracing.export_chrome_trace(str(out), party="alice")
+        assert n >= 3  # send + recv + decode at minimum
+        doc = json.loads(out.read_text())
+        events = doc["traceEvents"]
+        phases = {e["cat"]: e["ph"] for e in events}
+        assert phases["send"] == "X" and phases["recv"] == "i"
+        send_ev = next(e for e in events if e["cat"] == "send")
+        assert send_ev["dur"] > 0 and send_ev["args"]["nbytes"] == 1024
+        assert send_ev["pid"] == "alice"
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
 def test_tracing_disabled_records_nothing():
     tracing.clear()
     sp, rp = _pair()
