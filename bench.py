@@ -54,7 +54,7 @@ _FAST_RETRY = {
 }
 
 
-def _party_main(party, addresses, transport, result_path):
+def _party_main(party, addresses, transport, result_path, device_dma=False):
     import numpy as np
 
     import rayfed_tpu as fed
@@ -62,6 +62,8 @@ def _party_main(party, addresses, transport, result_path):
     comm = dict(_FAST_RETRY)
     if os.environ.get("FEDTPU_BENCH_WINDOW"):
         comm["send_window"] = int(os.environ["FEDTPU_BENCH_WINDOW"])
+    if device_dma:
+        comm["device_dma"] = True
     fed.init(
         addresses=addresses,
         party=party,
@@ -72,10 +74,26 @@ def _party_main(party, addresses, transport, result_path):
 
     n_elem = PAYLOAD_MB * 1024 * 1024 // 4
 
-    @fed.remote
-    def produce(i):
-        # Fresh tensor per round (dedup would skip repeat pushes).
-        return np.full((n_elem,), float(i), dtype=np.float32)
+    if device_dma:
+        # Device-resident payloads: the DMA lane parks live jax buffers
+        # on the transfer server and ships only a descriptor over the
+        # socket; the receiver pulls through the transfer engine's bulk
+        # transport (ICI/DCN on a pod, its socket transport in CPU sim).
+        import jax.numpy as jnp
+
+        @fed.remote
+        def produce(i):
+            import jax
+
+            return jax.block_until_ready(
+                jnp.full((n_elem,), float(i), dtype=jnp.float32)
+            )
+    else:
+
+        @fed.remote
+        def produce(i):
+            # Fresh tensor per round (dedup would skip repeat pushes).
+            return np.full((n_elem,), float(i), dtype=np.float32)
 
     @fed.remote
     def consume(x):
@@ -123,7 +141,7 @@ def _free_ports(n):
     return ports
 
 
-def run_transport(transport: str) -> float:
+def run_transport(transport: str, device_dma: bool = False) -> float:
     p1, p2 = _free_ports(2)
     addresses = {"alice": f"127.0.0.1:{p1}", "bob": f"127.0.0.1:{p2}"}
     mp = multiprocessing.get_context("spawn")
@@ -132,7 +150,7 @@ def run_transport(transport: str) -> float:
         procs = [
             mp.Process(
                 target=_party_main,
-                args=(party, addresses, transport, result_path),
+                args=(party, addresses, transport, result_path, device_dma),
             )
             for party in ("alice", "bob")
         ]
@@ -211,6 +229,37 @@ def _loopback_ceiling() -> float:
                 proc.terminate()
                 proc.join(timeout=10)
     return max(samples) if samples else 0.0
+
+
+def _try_dma_transport() -> Optional[float]:
+    """Device-DMA lane throughput (descriptor over the socket lane,
+    buffers pulled through the jax transfer engine). Parties are forced
+    onto the CPU backend: on this driver there is ONE real chip and two
+    party processes cannot share it — the number measures the lane's
+    machinery (register/descriptor/pull) end-to-end; on a pod the same
+    lane rides ICI/DCN. Best-effort: records nothing when the transfer
+    engine is unavailable."""
+    scrub = {
+        "PALLAS_AXON_POOL_IPS": None,
+        "JAX_PLATFORMS": "cpu",
+    }
+    saved = {k: os.environ.get(k) for k in scrub}
+    try:
+        for k, v in scrub.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return run_transport("tpu", device_dma=True)
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"dma bench skipped: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _try_build_fastwire() -> None:
@@ -333,6 +382,7 @@ def main() -> None:
     mfu = _try_train_mfu()
     native = run_transport("tcp")
     baseline = run_transport("grpc")
+    dma = _try_dma_transport()
     try:
         ceiling = _loopback_ceiling()
     except Exception:  # noqa: BLE001 - diagnostic only
@@ -349,6 +399,8 @@ def main() -> None:
     if ceiling:
         result["loopback_ceiling_gbps"] = round(ceiling, 3)
         result["pct_of_ceiling"] = round(100.0 * native / ceiling, 1)
+    if dma:
+        result["dma_cpu_gbps"] = round(dma, 3)
     if mfu:
         result.update(mfu)
     print(json.dumps(result))
