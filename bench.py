@@ -141,7 +141,7 @@ def _free_ports(n):
     return ports
 
 
-def run_transport(transport: str, device_dma: bool = False) -> float:
+def run_transport(transport: str, device_dma: bool = False) -> dict:
     p1, p2 = _free_ports(2)
     addresses = {"alice": f"127.0.0.1:{p1}", "bob": f"127.0.0.1:{p2}"}
     mp = multiprocessing.get_context("spawn")
@@ -170,26 +170,58 @@ def run_transport(transport: str, device_dma: bool = False) -> float:
                     f"{transport} bench party failed (exitcode={p.exitcode})"
                 )
         with open(result_path) as f:
-            return json.load(f)["gbps"]
+            res = json.load(f)
+        import statistics
+
+        # max = capability (continuity with earlier rounds); median is
+        # robust to the start-clock skew between the two party processes,
+        # which can inflate individual short timed windows.
+        return {
+            "max": res["gbps"],
+            "median": statistics.median(res["samples"]),
+            "samples": res["samples"],
+        }
+
+
+def _tune(sock) -> None:
+    """Apply the transport's own socket tuning to the ceiling probe —
+    without this the 'ceiling' uses default buffer sizes and the tuned
+    native lane can beat it (a >100% pct_of_ceiling is a measurement
+    artifact, not physics)."""
+    try:
+        from rayfed_tpu.proxy.tcp import sockio
+
+        sockio.tune_socket(sock)
+    except Exception:  # noqa: BLE001 - probe still works untuned
+        pass
 
 
 def _ceiling_tx(port: int, n: int, reps: int) -> None:
     """Sender half of the loopback-ceiling probe (own OS process, like a
     bench party)."""
+    # Import the tuning helper BEFORE connecting: the first rayfed_tpu
+    # import takes seconds on a busy host, and the receiver's first
+    # timed window must not absorb it.
+    try:
+        from rayfed_tpu.proxy.tcp import sockio  # noqa: F401
+    except Exception:  # noqa: BLE001
+        pass
     buf = bytearray(n)
     s = socket.socket()
     s.connect(("127.0.0.1", port))
+    _tune(s)
     with s:
         for _ in range(reps):
             for _ in range(ROUNDS):
                 s.sendall(buf)
 
 
-def _loopback_ceiling() -> float:
-    """The host's raw-socket loopback throughput, measured with the same
-    methodology as the transport benchmark (max over REPS reps of
-    ROUNDS x payload timed windows, sender in its own spawned process,
-    recv_into a pinned buffer, nothing else on the wire). The push
+def _loopback_ceiling() -> dict:
+    """The host's raw-socket loopback throughput as {"max", "median"}
+    over REPS reps of ROUNDS x payload timed windows (same methodology
+    and socket tuning as the transport benchmark; sender in its own
+    spawned process, recv_into a pinned buffer, nothing else on the
+    wire). The output JSON reports the MEDIAN. The push
     benchmark's number is only meaningful relative to this: on a
     single-core host the ceiling sits far below the NIC-less ideal
     because sender and receiver share the core, and it drifts with
@@ -209,6 +241,7 @@ def _loopback_ceiling() -> float:
         proc.start()
         srv.settimeout(60)
         conn, _ = srv.accept()
+        _tune(conn)
         with conn:
             view = memoryview(bytearray(n))
             for _ in range(REPS):
@@ -228,7 +261,11 @@ def _loopback_ceiling() -> float:
             if proc.is_alive():
                 proc.terminate()
                 proc.join(timeout=10)
-    return max(samples) if samples else 0.0
+    if not samples:
+        return {"max": 0.0, "median": 0.0}
+    import statistics
+
+    return {"max": max(samples), "median": statistics.median(samples)}
 
 
 def _try_dma_transport() -> Optional[float]:
@@ -250,7 +287,7 @@ def _try_dma_transport() -> Optional[float]:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-        return run_transport("tpu", device_dma=True)
+        return run_transport("tpu", device_dma=True)["max"]
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"dma bench skipped: {e!r}", file=sys.stderr)
         return None
@@ -380,25 +417,33 @@ def _try_train_mfu():
 def main() -> None:
     _try_build_fastwire()
     mfu = _try_train_mfu()
-    native = run_transport("tcp")
-    baseline = run_transport("grpc")
-    dma = _try_dma_transport()
+    # Ceiling probe immediately before the native measurement: this
+    # host's loopback throughput drifts tens of percent over minutes, so
+    # the two numbers are only comparable when adjacent in time.
     try:
         ceiling = _loopback_ceiling()
     except Exception:  # noqa: BLE001 - diagnostic only
-        ceiling = 0.0
+        ceiling = {"max": 0.0, "median": 0.0}
+    native = run_transport("tcp")
+    baseline = run_transport("grpc")
+    dma = _try_dma_transport()
     result = {
         "metric": "2-party cross-party push throughput, 100MB float32 tensors",
-        "value": round(native, 3),
+        "value": round(native["max"], 3),
         "unit": "GB/s",
-        "vs_baseline": round(native / baseline, 3),
-        "baseline_grpc_cloudpickle_gbps": round(baseline, 3),
+        "vs_baseline": round(native["max"] / baseline["max"], 3),
+        "value_median": round(native["median"], 3),
+        "baseline_grpc_cloudpickle_gbps": round(baseline["max"], 3),
         "rounds": ROUNDS,
         "payload_mb": PAYLOAD_MB,
     }
-    if ceiling:
-        result["loopback_ceiling_gbps"] = round(ceiling, 3)
-        result["pct_of_ceiling"] = round(100.0 * native / ceiling, 1)
+    if ceiling["median"]:
+        # Medians on both sides: peak-of-reps is inflatable by the
+        # parties' start-clock skew on short windows, the median is not.
+        result["loopback_ceiling_gbps"] = round(ceiling["median"], 3)
+        result["pct_of_ceiling"] = round(
+            100.0 * native["median"] / ceiling["median"], 1
+        )
     if dma:
         result["dma_cpu_gbps"] = round(dma, 3)
     if mfu:
