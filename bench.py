@@ -336,17 +336,48 @@ def _try_train_mfu():
     here = os.path.dirname(os.path.abspath(__file__))
     backend_deadline = int(os.environ.get("FEDTPU_MFU_BACKEND_DEADLINE", 240))
     hard_cap = int(os.environ.get("FEDTPU_MFU_HARD_CAP", 900))
-    # Flagship MFU configuration (overridable for tuning sweeps). The
-    # defaults are the proven round-2 measurement config: full per-layer
-    # remat + Pallas flash attention at batch 12 (remat='attn' keeps the
-    # attention outputs and is faster per step, but compiles
-    # pathologically slowly around the Pallas custom_vjp under scan —
-    # opt in via FEDTPU_MFU_REMAT=attn only with a pre-warmed cache).
+    # Flagship MFU configuration. Defaults are the proven round-2
+    # measurement config: full per-layer remat + Pallas flash attention
+    # at batch 12 (remat='attn' keeps the attention outputs and is
+    # faster per step, but compiles pathologically slowly around the
+    # Pallas custom_vjp under scan — use only with a pre-warmed cache).
+    # A checked-in benchmarks/mfu_config.json (written by
+    # tools/mfu_tune.py after an on-hardware sweep) overrides the
+    # defaults; FEDTPU_MFU_* env vars override both.
+    file_cfg = {}
+    cfg_path = os.path.join(here, "benchmarks", "mfu_config.json")
+    if os.path.exists(cfg_path):
+        try:
+            with open(cfg_path) as f:
+                file_cfg = json.load(f)
+        except Exception:  # noqa: BLE001 - defaults still apply
+            file_cfg = {}
+        if not isinstance(file_cfg, dict):
+            file_cfg = {}
     mfu_cfg = {
-        "batch": int(os.environ.get("FEDTPU_MFU_BATCH", 12)),
-        "steps": int(os.environ.get("FEDTPU_MFU_STEPS", 10)),
-        "remat": os.environ.get("FEDTPU_MFU_REMAT", "1"),
+        "batch": int(os.environ.get(
+            "FEDTPU_MFU_BATCH", file_cfg.get("batch", 12))),
+        "steps": int(os.environ.get(
+            "FEDTPU_MFU_STEPS", file_cfg.get("steps", 10))),
+        "remat": str(os.environ.get(
+            "FEDTPU_MFU_REMAT", file_cfg.get("remat", "1"))),
     }
+    cache_dir = os.path.join(here, ".jax_cache")
+    cache_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
+    if (
+        mfu_cfg["remat"] == "attn"
+        and "FEDTPU_MFU_REMAT" not in os.environ
+        and not cache_warm
+    ):
+        # A file-tuned 'attn' winner presumes the warmed compilation
+        # cache it was swept with; cold, its compile blows the hard cap
+        # (the exact failure the watchdog exists for). Fall back to the
+        # safe full-remat config; an explicit env override still wins.
+        print(
+            "mfu: ignoring remat='attn' from mfu_config.json (compilation "
+            "cache is cold); using full remat", file=sys.stderr,
+        )
+        mfu_cfg["remat"] = "1"
     remat_arg = (
         "'attn'" if mfu_cfg["remat"] == "attn"
         else str(mfu_cfg["remat"] == "1")
@@ -360,10 +391,13 @@ def _try_train_mfu():
         "if jax.default_backend() != 'tpu':\n"
         "    sys.exit(3)\n"
         "from contextlib import redirect_stdout\n"
+        "from transformer_train_benchmark import FLAGSHIP\n"
         "from transformer_train_benchmark import run as train_run\n"
         "with redirect_stdout(sys.stderr):\n"
-        f"    r = train_run(2048, 12, 2048, batch={mfu_cfg['batch']}, "
-        f"steps={mfu_cfg['steps']}, vocab=32768, remat={remat_arg})\n"
+        "    r = train_run(FLAGSHIP['d_model'], FLAGSHIP['n_layers'], "
+        f"FLAGSHIP['seq'], batch={mfu_cfg['batch']}, "
+        f"steps={mfu_cfg['steps']}, vocab=FLAGSHIP['vocab'], "
+        f"remat={remat_arg})\n"
         "print(json.dumps({'train_tokens_per_s': round(r['tokens_per_s']),"
         "'train_mfu': round(r['mfu'], 4),"
         "'train_n_params': r['n_params'], 'train_seq': r['seq']}))\n"

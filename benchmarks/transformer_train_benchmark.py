@@ -38,6 +38,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# The flagship measurement shape shared by bench.py's MFU stage and
+# tools/mfu_tune.py — one source of truth so a committed tuning config
+# and warmed compilation cache always describe the program bench.py
+# actually measures.
+FLAGSHIP = {"d_model": 2048, "n_layers": 12, "seq": 2048, "vocab": 32768}
+
+
 def enable_compilation_cache():
     """Point JAX at the repo-local persistent compilation cache so the
     flagship step compiles once per (program, jaxlib, chip) ever — a
