@@ -17,7 +17,8 @@
 Every party holds the same frozen base LM (distributed once out-of-band)
 and fine-tunes low-rank adapters on its private tokens; the FedAvg round
 aggregates just the A/B matrices — orders of magnitude smaller than the
-base weights (`rayfed_tpu.models.lora.lora_nbytes` prints the ratio).
+base weights (the ratio printed per round is derived from
+`rayfed_tpu.models.lora.lora_nbytes`, the adapter's byte size).
 The merged model is identical in every party after each round.
 
     python examples/fedavg_lora.py alice 127.0.0.1:9131 127.0.0.1:9132

@@ -1,0 +1,169 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Property-based tests for the wire codecs (hypothesis).
+
+The zero-pickle tree codec, the compression envelope, and the frame
+header are the attack/correctness surface every byte crosses — fuzz them
+instead of trusting a handful of fixed cases: arbitrary pytrees
+round-trip exactly; truncated or corrupted inputs raise controlled
+errors rather than returning silently wrong data or crashing the
+process."""
+
+import msgpack
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from rayfed_tpu._private import serialization as ser
+from rayfed_tpu.proxy.tcp import wire
+
+DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_,
+          np.float16]
+
+
+def arrays():
+    def build(draw_tuple):
+        dtype, shape, seed = draw_tuple
+        rng = np.random.default_rng(seed)
+        if dtype == np.bool_:
+            return rng.integers(0, 2, size=shape).astype(np.bool_)
+        info_int = np.issubdtype(dtype, np.integer)
+        if info_int:
+            return rng.integers(0, 100, size=shape).astype(dtype)
+        return rng.normal(size=shape).astype(dtype)
+
+    shapes = st.lists(st.integers(0, 5), min_size=0, max_size=3).map(tuple)
+    return st.tuples(
+        st.sampled_from(DTYPES), shapes, st.integers(0, 2**31)
+    ).map(build)
+
+
+def leaves():
+    return st.one_of(
+        arrays(),
+        st.integers(-2**31, 2**31),
+        st.floats(allow_nan=False, allow_infinity=False, width=32),
+        st.booleans(),
+        st.none(),
+        st.text(max_size=12),
+        st.binary(max_size=32),
+    )
+
+
+def trees():
+    return st.recursive(
+        leaves(),
+        lambda children: st.one_of(
+            st.lists(children, max_size=4),
+            st.dictionaries(st.text(max_size=6), children, max_size=4),
+            st.lists(children, max_size=3).map(tuple),
+        ),
+        max_leaves=12,
+    )
+
+
+def _assert_equal(a, b):
+    if isinstance(a, np.ndarray):
+        assert isinstance(b, np.ndarray), type(b)
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    elif isinstance(a, dict):
+        assert isinstance(b, dict) and set(a) == set(b)
+        for k in a:
+            _assert_equal(a[k], b[k])
+    elif isinstance(a, (list, tuple)):
+        assert type(a) is type(b) and len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_equal(x, y)
+    elif isinstance(a, float):
+        assert a == pytest.approx(b, nan_ok=True)
+    else:
+        assert a == b, (a, b)
+
+
+@settings(max_examples=120, deadline=None)
+@given(trees())
+def test_payload_roundtrip(tree):
+    kind, meta, buffers = ser.encode_payload(tree)
+    payload = ser.concat_buffers(buffers)
+    out = ser.decode_payload(kind, meta, payload, allowed_list=None)
+    _assert_equal(tree, out)
+
+
+@settings(max_examples=60, deadline=None)
+@given(trees(), st.integers(0, 2**31))
+def test_truncated_tree_payload_never_returns_wrong_data(tree, seed):
+    kind, meta, buffers = ser.encode_payload(tree)
+    if kind != "tree":
+        return
+    payload = ser.concat_buffers(buffers)
+    if len(payload) == 0:
+        return
+    cut = np.random.default_rng(seed).integers(0, len(payload))
+    try:
+        out = ser.decode_payload(kind, meta, payload[:cut], allowed_list=None)
+    except Exception:
+        return  # controlled rejection is the expected outcome
+    # If decode somehow succeeds on a shorter payload, it must still be
+    # byte-identical data (possible only when the cut removed nothing
+    # the arrays used, e.g. all-empty arrays).
+    _assert_equal(tree, out)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.binary(min_size=0, max_size=4096),
+       st.sampled_from(["zlib", "zstd"]),
+       st.integers(1, 5))
+def test_compression_roundtrip(raw, scheme, level):
+    packed = ser.compress_buffers([raw], scheme, level)
+    if packed is None:
+        return  # incompressible payloads legitimately ship raw
+    blob, raw_len = packed
+    assert raw_len == len(raw)
+    out = ser.decompress_payload(blob, scheme, raw_len, max_bytes=1 << 20)
+    assert bytes(memoryview(out)) == raw
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.binary(min_size=1, max_size=512),
+       st.sampled_from(["zlib", "zstd"]))
+def test_garbage_never_decompresses_silently(blob, scheme):
+    # Random bytes must be rejected, not silently produce output of the
+    # declared length.
+    try:
+        out = ser.decompress_payload(blob, scheme, len(blob), max_bytes=1 << 20)
+    except Exception:
+        return
+    # A random blob that IS a valid frame must at least honor raw_len.
+    assert memoryview(out).nbytes == len(blob)
+
+
+@settings(max_examples=120, deadline=None)
+@given(st.integers(0, 255),
+       st.dictionaries(st.text(max_size=8),
+                       st.one_of(st.text(max_size=8), st.integers(0, 2**31),
+                                 st.booleans(), st.binary(max_size=16)),
+                       max_size=6),
+       st.integers(0, 2**40))
+def test_frame_prefix_header_roundtrip(ftype, header, payload_len):
+    raw = wire.encode_prefix_and_header(ftype, header, payload_len)
+    magic, version, ft, hlen, plen = wire._PREFIX.unpack(
+        raw[:wire.PREFIX_LEN]
+    )
+    assert magic == wire.WIRE_MAGIC and version == wire.WIRE_VERSION
+    assert ft == ftype and plen == payload_len
+    hdr = msgpack.unpackb(raw[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen],
+                          raw=False)
+    assert hdr == header
