@@ -267,3 +267,95 @@ def make_generate_fn(
         )
 
     return sharded_generate
+
+
+def make_beam_search_fn(
+    cfg: tfm.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    n_beams: int,
+    jit: bool = True,
+):
+    """Build ``beam_search(params, prompt) -> (seqs, scores)``.
+
+    Fixed-length beam search (no EOS shortcut — every beam decodes
+    ``max_new_tokens``), returning ``seqs`` (B, n_beams, S+max_new) and
+    their total log-probabilities ``scores`` (B, n_beams), best first.
+
+    TPU-first shape: ONE compile for the whole search — the step body is
+    a ``lax.scan`` whose carry holds the flattened (B*n_beams) decode
+    rows; beam reordering is a batched gather over the K/V cache's batch
+    dim (``jnp.take``), which XLA lowers to an on-device dynamic-gather
+    with no host trips; all candidate expansion is a single
+    (B, n_beams*vocab) ``top_k``. Prefill runs once at batch B and the
+    cache is tiled to B*n_beams afterwards, so prompt compute is not
+    duplicated per beam.
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if n_beams < 1:
+        raise ValueError("n_beams must be >= 1")
+    k_beams = n_beams
+    vocab = cfg.vocab
+
+    def beam_search(params, prompt):
+        b, s = prompt.shape
+        cache = init_cache(cfg, b, s + max_new_tokens - 1)
+        last_logits, cache = prefill(params, prompt, cache, cfg)
+        logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+
+        # First expansion: top-K tokens of the prompt's next-token
+        # distribution seed the K beams (B, K). With K > vocab only
+        # vocab distinct depth-1 prefixes exist — the surplus beams are
+        # seeded dead (-inf) and repopulated by later expansions.
+        k0 = min(k_beams, vocab)
+        scores0, first0 = jax.lax.top_k(logp0, k0)
+        pad = k_beams - k0
+        scores = jnp.pad(scores0, ((0, 0), (0, pad)),
+                         constant_values=-jnp.inf)
+        first = jnp.pad(first0, ((0, 0), (0, pad))).astype(prompt.dtype)
+
+        # Tile the cache to B*K rows: row b*K + j = beam j of batch b.
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, k_beams, axis=1), cache
+        )
+        seqs = jnp.zeros((b, k_beams, max_new_tokens), prompt.dtype)
+        seqs = seqs.at[:, :, 0].set(first)
+
+        def step(carry, t):
+            tok, cache, seqs, scores = carry
+            logits, cache = forward_with_cache(
+                params, tok.reshape(b * k_beams, 1), cache, s + t, cfg
+            )
+            logp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            ).reshape(b, k_beams, vocab)
+            cand = scores[:, :, None] + logp           # (B, K, V)
+            scores, flat = jax.lax.top_k(
+                cand.reshape(b, k_beams * vocab), k_beams
+            )
+            parent = flat // vocab                     # (B, K) beam index
+            nxt = (flat % vocab).astype(tok.dtype)     # (B, K) token
+            # Reorder histories and cache rows under the surviving beams.
+            seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+            seqs = seqs.at[:, :, t + 1].set(nxt)
+            rows = (
+                jnp.arange(b)[:, None] * k_beams + parent
+            ).reshape(b * k_beams)
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, rows, axis=1), cache
+            )
+            return (nxt, cache, seqs, scores), None
+
+        if max_new_tokens > 1:
+            (_, _, seqs, scores), _ = jax.lax.scan(
+                step,
+                (first, cache, seqs, scores),
+                jnp.arange(max_new_tokens - 1),
+            )
+        prompts = jnp.broadcast_to(
+            prompt[:, None, :], (b, k_beams, s)
+        ).astype(prompt.dtype)
+        return jnp.concatenate([prompts, seqs], axis=2), scores
+
+    return jax.jit(beam_search) if jit else beam_search

@@ -66,6 +66,9 @@ _SLOW_TESTS = {
     "test_pipeline_feeds_train_step",
     "test_gate_times_out_when_peer_never_opts_in",
     "test_greedy_generate_matches_naive_loop",
+    "test_beam_search_finds_exhaustive_argmax",
+    "test_beam_search_beam1_is_greedy",
+    "test_beam_search_batched_rows_do_not_cross_contaminate",
     "test_fed_train_step_dp_tp",
     "test_remat_matches_non_remat",
     "test_pp_grads_match_serial",

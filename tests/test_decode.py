@@ -201,3 +201,91 @@ def test_sampling_params_validated():
         decode.make_generate_fn(cfg, max_new_tokens=2, top_p=0.0)
     with pytest.raises(ValueError, match="top_p"):
         decode.make_generate_fn(cfg, max_new_tokens=2, top_p=1.5)
+
+
+def _brute_force_best(params, prompt, cfg, t_new):
+    """Exhaustive argmax over all vocab^t_new continuations (tiny shapes)."""
+    import itertools
+
+    best_score, best_seq = -np.inf, None
+    for cont in itertools.product(range(cfg.vocab), repeat=t_new):
+        toks = jnp.concatenate(
+            [prompt, jnp.asarray([cont], prompt.dtype)], axis=1
+        )
+        logits = tfm.forward(params, toks, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        score = sum(
+            float(logp[0, prompt.shape[1] - 1 + i, cont[i]])
+            for i in range(t_new)
+        )
+        if score > best_score:
+            best_score, best_seq = score, cont
+    return best_score, best_seq
+
+
+def test_beam_search_finds_exhaustive_argmax():
+    """With n_beams >= vocab^(t-1) the beam can never prune the optimum:
+    the top beam must equal the brute-force best continuation, score and
+    tokens both."""
+    cfg = tfm.tiny_config(vocab=6, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab)
+
+    t_new = 2
+    bs = decode.make_beam_search_fn(cfg, max_new_tokens=t_new,
+                                    n_beams=cfg.vocab ** (t_new - 1) * 2)
+    seqs, scores = bs(params, prompt)
+    ref_score, ref_seq = _brute_force_best(params, prompt, cfg, t_new)
+    got = tuple(int(x) for x in np.asarray(seqs)[0, 0, -t_new:])
+    assert got == ref_seq, (got, ref_seq)
+    np.testing.assert_allclose(float(scores[0, 0]), ref_score, rtol=1e-4)
+    # Scores are sorted best-first.
+    s = np.asarray(scores)[0]
+    assert np.all(s[:-1] >= s[1:] - 1e-6)
+
+
+def test_beam_search_beam1_is_greedy():
+    cfg = tfm.tiny_config(vocab=16, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(2), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0, cfg.vocab)
+
+    bs = decode.make_beam_search_fn(cfg, max_new_tokens=4, n_beams=1)
+    seqs, _ = bs(params, prompt)
+    greedy = decode.make_generate_fn(cfg, max_new_tokens=4)(params, prompt)
+    np.testing.assert_array_equal(np.asarray(seqs)[:, 0, :],
+                                  np.asarray(greedy))
+
+
+def test_beam_search_validates_args():
+    cfg = tfm.tiny_config()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        decode.make_beam_search_fn(cfg, max_new_tokens=0, n_beams=2)
+    with pytest.raises(ValueError, match="n_beams"):
+        decode.make_beam_search_fn(cfg, max_new_tokens=2, n_beams=0)
+
+
+def test_beam_search_batched_rows_do_not_cross_contaminate():
+    """B>=2 with n_beams>=2: each batch element's top beam must equal
+    ITS OWN brute-force best — any mismatch in the flattened
+    (b * n_beams + parent) cache-gather arithmetic would leak K/V rows
+    across batch elements."""
+    cfg = tfm.tiny_config(vocab=5, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(4), cfg)
+    prompts = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 0, cfg.vocab)
+
+    t_new = 2
+    bs = decode.make_beam_search_fn(cfg, max_new_tokens=t_new,
+                                    n_beams=cfg.vocab ** (t_new - 1))
+    seqs, scores = bs(params, prompts)
+    for row in range(2):
+        ref_score, ref_seq = _brute_force_best(
+            params, prompts[row:row + 1], cfg, t_new
+        )
+        got = tuple(int(x) for x in np.asarray(seqs)[row, 0, -t_new:])
+        assert got == ref_seq, (row, got, ref_seq)
+        np.testing.assert_allclose(
+            float(scores[row, 0]), ref_score, rtol=1e-4
+        )
