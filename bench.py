@@ -388,7 +388,8 @@ def _try_train_mfu():
         "from transformer_train_benchmark import enable_compilation_cache\n"
         "enable_compilation_cache()\n"
         "import jax\n"
-        "if jax.default_backend() != 'tpu':\n"
+        "from rayfed_tpu.utils import is_tpu_backend\n"
+        "if not is_tpu_backend():\n"
         "    sys.exit(3)\n"
         "from contextlib import redirect_stdout\n"
         "from transformer_train_benchmark import FLAGSHIP\n"

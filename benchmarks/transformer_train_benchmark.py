@@ -73,7 +73,9 @@ def run(d_model=512, n_layers=8, seq=1024, batch=8, steps=20, remat=None,
     from rayfed_tpu.parallel import sharding as shd
     from rayfed_tpu.parallel.train import make_fed_train_step
 
-    on_tpu = jax.default_backend() == "tpu"
+    from rayfed_tpu.utils import is_tpu_backend
+
+    on_tpu = is_tpu_backend()
     # Progress marker: a supervising process (bench.py's watchdog) reads
     # this to distinguish "wedged accelerator" from "long XLA compile".
     print(f"BACKEND_UP {jax.default_backend()}", flush=True)

@@ -360,7 +360,9 @@ def flash_attention(
     block_k = min(block_k, _pow2_block(sk, cap=block_k))
     assert sq % block_q == 0 and sk % block_k == 0, (sq, sk, block_q, block_k)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from rayfed_tpu.utils import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     return _flash_attention_diff(q, k, v, block_q, block_k, interpret, q_offset)
 
 

@@ -137,7 +137,9 @@ def ring_flash_attention(q, k, v, axis_name: str,
     from rayfed_tpu.ops.flash_attention import _pow2_block
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from rayfed_tpu.utils import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     s_local = q.shape[1]
     block_q = _pow2_block(s_local, cap=block_q)
     block_k = _pow2_block(s_local, cap=block_k)

@@ -95,7 +95,9 @@ def make_fed_train_step(
     if attn not in ("auto", "flash", "xla"):
         raise ValueError(f"attn must be 'auto', 'flash', or 'xla'; got {attn!r}")
     if attn == "auto":
-        attn = "flash" if jax.default_backend() == "tpu" else "xla"
+        from rayfed_tpu.utils import is_tpu_backend
+
+        attn = "flash" if is_tpu_backend() else "xla"
 
     if use_ring:
         # Sequence-parallel attention: shard_map over the seq axis with K/V

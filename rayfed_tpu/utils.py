@@ -108,6 +108,18 @@ def setup_logger(
 _ADDR_RE = re.compile(r"^(?P<host>[^:/ ]+):(?P<port>\d{1,5})$")
 
 
+def is_tpu_backend() -> bool:
+    """True when jax's active backend is a TPU — including tunneled/
+    plugin platforms that report their own name (the axon plugin
+    registers as "axon" in some versions, "tpu" in others). Every
+    TPU-vs-interpret gate in the package must use this, not a string
+    compare, so a platform-name change cannot silently disable the
+    Pallas kernels or the remat defaults."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def parse_address(address: str) -> "tuple[str, int]":
     """Split a validated ``host:port`` into its parts — the one place
     the accepted address format is interpreted (transports and the

@@ -57,7 +57,8 @@ def run_candidate(cfg: dict, steps: int, timeout_s: int) -> dict | None:
         "from transformer_train_benchmark import run, enable_compilation_cache\n"
         "enable_compilation_cache()\n"
         "import jax\n"
-        "if jax.default_backend() != 'tpu':\n"
+        "from rayfed_tpu.utils import is_tpu_backend\n"
+        "if not is_tpu_backend():\n"
         "    sys.exit(3)\n"
         "from contextlib import redirect_stdout\n"
         "from transformer_train_benchmark import FLAGSHIP\n"
