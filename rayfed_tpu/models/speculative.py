@@ -1,0 +1,165 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Greedy draft-and-verify (Leviathan et al.'s rejection scheme reduces to
+prefix matching when both models decode greedily): per round the draft
+proposes ``k_draft`` tokens autoregressively, the target scores ALL of
+them in one batched forward, the longest matching prefix is accepted and
+the target's own next token is appended as the correction — so every
+round emits between 1 and ``k_draft``+1 tokens for ONE target forward,
+and the output is **exactly** the target model's greedy decoding
+(pinned in tests). On TPU this converts the memory-bound one-token-at-
+a-time decode into k+1-token target forwards that amortize the HBM
+weight streaming the same way a larger batch would.
+
+TPU-first mechanics (everything static-shaped inside one jit):
+
+ - The loop is a ``lax.while_loop`` whose carry holds the token buffer,
+   both K/V caches, and the scalar write position; each round's variable
+   acceptance count only moves the position scalar.
+ - Cache validity bookkeeping is COLLAPSED by recomputation: each round
+   re-runs the trailing ``k_draft+1``-token window through both models
+   at its true offset before extending. Re-processing tokens whose
+   cache entries were already correct rewrites identical values, and the
+   window always covers the one position a rejection can have staled
+   (the correction slot), so no validity state needs tracking — the
+   cost is one extra window's worth of compute per round.
+ - Batched prompts accept ``min`` over rows per round (rows with longer
+   matches simply waste some speculation) so the position stays scalar;
+   the emitted correction token is still per-row correct because it
+   conditions only on accepted tokens.
+
+The reference engine has no inference at all; within this framework the
+draft model is the natural thing to train with federated distillation
+and serve next to the aggregated target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rayfed_tpu.models import transformer as tfm
+from rayfed_tpu.models.decode import forward_with_cache, init_cache, prefill
+
+
+def make_speculative_generate_fn(
+    cfg: tfm.TransformerConfig,
+    draft_cfg: tfm.TransformerConfig,
+    *,
+    max_new_tokens: int,
+    k_draft: int = 4,
+    jit: bool = True,
+):
+    """Build ``generate(params, draft_params, prompt) -> (B, S+max_new)``.
+
+    ``params``/``cfg`` are the target model, ``draft_params``/
+    ``draft_cfg`` the proposal model (same vocab required). Greedy only;
+    the result is bit-for-bit the target's own greedy decode. Prompt
+    length must be at least ``k_draft + 1`` (the verification window).
+    """
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if k_draft < 1:
+        raise ValueError("k_draft must be >= 1")
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError(
+            f"target and draft must share a vocab; got {cfg.vocab} vs "
+            f"{draft_cfg.vocab}"
+        )
+    w = k_draft + 1  # verification window
+
+    def generate(params, draft_params, prompt):
+        b, s = prompt.shape
+        if s < w:
+            raise ValueError(
+                f"prompt length {s} shorter than the verification window "
+                f"{w} (= k_draft + 1)"
+            )
+        total = s + max_new_tokens
+        # Slack absorbs the last round's overshoot (writes past `total`
+        # are never returned; cache slots past it are never attended).
+        cap = total + k_draft + 1
+        buf = jnp.zeros((b, cap), prompt.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+        t_cache = init_cache(cfg, b, cap)
+        d_cache = init_cache(draft_cfg, b, cap)
+        _, t_cache = prefill(params, prompt, t_cache, cfg)
+        _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
+
+        def cond(carry):
+            return carry[3] < total
+
+        def round_(carry):
+            buf, t_cache, d_cache, pos = carry
+            win = jax.lax.dynamic_slice(buf, (0, pos - w), (b, w))
+
+            # Draft: window pass re-validates its cache and yields q_1;
+            # k_draft-1 single-token steps yield q_2..q_k.
+            d_logits, d_cache = forward_with_cache(
+                draft_params, win, d_cache, pos - w, draft_cfg
+            )
+            q1 = jnp.argmax(d_logits[:, -1], axis=-1).astype(buf.dtype)
+
+            def d_step(c, _):
+                tok, cache, p = c
+                lg, cache = forward_with_cache(
+                    draft_params, tok[:, None], cache, p, draft_cfg
+                )
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(buf.dtype)
+                return (nxt, cache, p + 1), nxt
+
+            (_, d_cache, _), qs = jax.lax.scan(
+                d_step, (q1, d_cache, pos), None, length=k_draft - 1
+            )
+            q = jnp.concatenate(
+                [q1[:, None], jnp.moveaxis(qs, 0, 1)], axis=1
+            ) if k_draft > 1 else q1[:, None]                     # (B, k)
+
+            # Target: one forward over [window, q_1..q_k] — its logits at
+            # indices w-1..w+k-1 are the argmax choices for positions
+            # pos..pos+k given the proposals.
+            t_in = jnp.concatenate([win, q], axis=1)
+            t_logits, t_cache = forward_with_cache(
+                params, t_in, t_cache, pos - w, cfg
+            )
+            t_pred = jnp.argmax(t_logits[:, w - 1:], axis=-1).astype(
+                buf.dtype
+            )                                                    # (B, k+1)
+
+            # Longest prefix of proposals the target agrees with, min
+            # over batch rows (keeps `pos` scalar; see module docstring).
+            eq = (q == t_pred[:, :k_draft]).astype(jnp.int32)
+            n = jnp.min(jnp.cumprod(eq, axis=1).sum(axis=1))
+
+            # Emit q_1..q_n then the target's correction t_{n+1}. Slots
+            # past n are filled with proposals; a later round overwrites
+            # them before they can ever be part of the consumed prefix.
+            idx = jnp.arange(k_draft + 1)[None, :]
+            padded_q = jnp.concatenate([q, q[:, -1:]], axis=1)
+            correction = jnp.take_along_axis(
+                t_pred, jnp.full((b, 1), n), axis=1
+            )
+            emit = jnp.where(idx == n, correction, padded_q)
+            buf = jax.lax.dynamic_update_slice(buf, emit, (0, pos))
+            return buf, t_cache, d_cache, pos + n + 1
+
+        buf, _, _, _ = jax.lax.while_loop(
+            cond, round_, (buf, t_cache, d_cache, jnp.asarray(s, jnp.int32))
+        )
+        return jax.lax.dynamic_slice(buf, (0, 0), (b, total))
+
+    return jax.jit(generate) if jit else generate

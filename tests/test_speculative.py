@@ -1,0 +1,86 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Speculative decoding: the output must EQUAL the target's own greedy
+decode — speculation may only change how fast tokens are produced, never
+which tokens."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rayfed_tpu.models import decode, speculative, transformer as tfm
+
+
+def _models(seed_t=0, seed_d=1):
+    cfg = tfm.tiny_config(vocab=32, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    dcfg = tfm.tiny_config(vocab=32, d_model=16, n_heads=2, n_layers=1,
+                           d_ff=32, compute_dtype=jnp.float32)
+    return (cfg, tfm.init_params(jax.random.PRNGKey(seed_t), cfg),
+            dcfg, tfm.init_params(jax.random.PRNGKey(seed_d), dcfg))
+
+
+def test_speculative_equals_target_greedy():
+    cfg, params, dcfg, dparams = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab)
+    for t_new, k in [(6, 2), (5, 4), (1, 3), (4, 1)]:
+        spec = speculative.make_speculative_generate_fn(
+            cfg, dcfg, max_new_tokens=t_new, k_draft=k
+        )
+        greedy = decode.make_generate_fn(cfg, max_new_tokens=t_new)
+        np.testing.assert_array_equal(
+            np.asarray(spec(params, dparams, prompt)),
+            np.asarray(greedy(params, prompt)),
+            err_msg=f"t_new={t_new} k={k}",
+        )
+
+
+def test_speculative_with_perfect_draft():
+    """Draft == target: every proposal is accepted and the result is
+    still exactly the greedy decode."""
+    cfg, params, _, _ = _models()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab)
+    spec = speculative.make_speculative_generate_fn(
+        cfg, cfg, max_new_tokens=7, k_draft=3
+    )
+    greedy = decode.make_generate_fn(cfg, max_new_tokens=7)
+    np.testing.assert_array_equal(
+        np.asarray(spec(params, params, prompt)),
+        np.asarray(greedy(params, prompt)),
+    )
+
+
+def test_speculative_validates_args():
+    cfg, params, dcfg, dparams = _models()
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        speculative.make_speculative_generate_fn(
+            cfg, dcfg, max_new_tokens=0, k_draft=2
+        )
+    with pytest.raises(ValueError, match="k_draft"):
+        speculative.make_speculative_generate_fn(
+            cfg, dcfg, max_new_tokens=2, k_draft=0
+        )
+    bad = tfm.tiny_config(vocab=99)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative.make_speculative_generate_fn(
+            cfg, bad, max_new_tokens=2, k_draft=2
+        )
+    spec = speculative.make_speculative_generate_fn(
+        cfg, dcfg, max_new_tokens=2, k_draft=4
+    )
+    short = jnp.zeros((1, 3), jnp.int32)  # < k_draft + 1
+    with pytest.raises(ValueError, match="verification window"):
+        spec(params, dparams, short)
