@@ -63,6 +63,7 @@ def make_speculative_generate_fn(
     max_new_tokens: int,
     k_draft: int = 4,
     jit: bool = True,
+    return_stats: bool = False,
 ):
     """Build ``generate(params, draft_params, prompt) -> (B, S+max_new)``.
 
@@ -70,6 +71,13 @@ def make_speculative_generate_fn(
     ``draft_cfg`` the proposal model (same vocab required). Greedy only;
     the result is bit-for-bit the target's own greedy decode. Prompt
     length must be at least ``k_draft + 1`` (the verification window).
+
+    With ``return_stats=True`` the function returns ``(tokens,
+    n_rounds)`` — the number of verify rounds (= target forwards) the
+    generation took: ``max_new_tokens / n_rounds`` is the realized
+    tokens-per-target-pass, the speedup knob speculation exists for
+    (ceil(max_new / (k_draft+1)) when the draft always agrees,
+    max_new when it never does).
     """
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
@@ -100,11 +108,8 @@ def make_speculative_generate_fn(
         _, t_cache = prefill(params, prompt, t_cache, cfg)
         _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
 
-        def cond(carry):
-            return carry[3] < total
-
         def round_(carry):
-            buf, t_cache, d_cache, pos = carry
+            buf, t_cache, d_cache, pos, rounds = carry
             win = jax.lax.dynamic_slice(buf, (0, pos - w), (b, w))
 
             # Draft: window pass re-validates its cache and yields q_1;
@@ -155,11 +160,17 @@ def make_speculative_generate_fn(
             )
             emit = jnp.where(idx == n, correction, padded_q)
             buf = jax.lax.dynamic_update_slice(buf, emit, (0, pos))
-            return buf, t_cache, d_cache, pos + n + 1
+            return buf, t_cache, d_cache, pos + n + 1, rounds + 1
 
-        buf, _, _, _ = jax.lax.while_loop(
-            cond, round_, (buf, t_cache, d_cache, jnp.asarray(s, jnp.int32))
+        def cond(carry):
+            return carry[3] < total
+
+        buf, _, _, _, rounds = jax.lax.while_loop(
+            cond, round_,
+            (buf, t_cache, d_cache, jnp.asarray(s, jnp.int32),
+             jnp.asarray(0, jnp.int32)),
         )
-        return jax.lax.dynamic_slice(buf, (0, 0), (b, total))
+        out = jax.lax.dynamic_slice(buf, (0, 0), (b, total))
+        return (out, rounds) if return_stats else out
 
     return jax.jit(generate) if jit else generate

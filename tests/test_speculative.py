@@ -49,18 +49,26 @@ def test_speculative_equals_target_greedy():
 
 
 def test_speculative_with_perfect_draft():
-    """Draft == target: every proposal is accepted and the result is
-    still exactly the greedy decode."""
+    """Draft == target: every proposal is accepted, the result is still
+    exactly the greedy decode, and the round count hits the theoretical
+    floor ceil(max_new / (k_draft + 1))."""
     cfg, params, _, _ = _models()
     prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab)
     spec = speculative.make_speculative_generate_fn(
-        cfg, cfg, max_new_tokens=7, k_draft=3
+        cfg, cfg, max_new_tokens=7, k_draft=3, return_stats=True
     )
     greedy = decode.make_generate_fn(cfg, max_new_tokens=7)
+    out, rounds = spec(params, params, prompt)
     np.testing.assert_array_equal(
-        np.asarray(spec(params, params, prompt)),
-        np.asarray(greedy(params, prompt)),
+        np.asarray(out), np.asarray(greedy(params, prompt))
     )
+    # ceil(7/4) = 2 rounds when every proposal is accepted. Allow +2
+    # slack: the draft's split compute path (window pass + single steps)
+    # and the target's fused forward chunk matmuls differently, and a
+    # one-ULP logit tie on some backend could reject a proposal without
+    # breaking correctness (the output equality above is the real pin).
+    floor = -(-7 // 4)
+    assert floor <= int(rounds) <= floor + 2, int(rounds)
 
 
 def test_speculative_validates_args():
