@@ -14,14 +14,15 @@
 
 """Speculative decoding: a small draft model proposes, the target verifies.
 
-Greedy draft-and-verify (Leviathan et al.'s rejection scheme reduces to
-prefix matching when both models decode greedily): per round the draft
-proposes ``k_draft`` tokens autoregressively, the target scores ALL of
-them in one batched forward, the longest matching prefix is accepted and
-the target's own next token is appended as the correction — so every
-round emits between 1 and ``k_draft``+1 tokens for ONE target forward,
-and the output is **exactly** the target model's greedy decoding
-(pinned in tests). On TPU this converts the memory-bound one-token-at-
+Draft-and-verify: per round the draft proposes ``k_draft`` tokens
+autoregressively, the target scores ALL of them in one batched forward,
+and 1 to ``k_draft``+1 tokens are emitted per target pass. At
+``temperature=0`` (greedy) verification is prefix matching and the
+output is **exactly** the target's greedy decoding; at ``temperature >
+0`` the full Leviathan et al. rejection-sampling scheme runs (accept
+with min(1, p_target/p_draft), resample the first rejection from the
+normalized residual) and the output DISTRIBUTION is exactly ancestral
+sampling from the target — both pinned in tests. On TPU this converts the memory-bound one-token-at-
 a-time decode into k+1-token target forwards that amortize the HBM
 weight streaming the same way a larger batch would.
 
@@ -62,15 +63,29 @@ def make_speculative_generate_fn(
     *,
     max_new_tokens: int,
     k_draft: int = 4,
+    temperature: float = 0.0,
     jit: bool = True,
     return_stats: bool = False,
 ):
     """Build ``generate(params, draft_params, prompt) -> (B, S+max_new)``.
 
     ``params``/``cfg`` are the target model, ``draft_params``/
-    ``draft_cfg`` the proposal model (same vocab required). Greedy only;
-    the result is bit-for-bit the target's own greedy decode. Prompt
-    length must be at least ``k_draft + 1`` (the verification window).
+    ``draft_cfg`` the proposal model (same vocab required). At the
+    default ``temperature=0`` decoding is greedy and the result is
+    bit-for-bit the target's own greedy decode. Prompt length must be
+    at least ``k_draft + 1`` (the verification window).
+
+    With ``temperature > 0`` the full rejection-sampling scheme runs
+    (Leviathan et al.): the draft SAMPLES its proposals, each is
+    accepted with probability ``min(1, p_target/p_draft)``, and the
+    first rejection resamples from the normalized residual
+    ``max(p_target - p_draft, 0)`` — the output distribution is exactly
+    ancestral sampling from the target at that temperature (pinned
+    against the enumerated exact distribution in tests). ``generate``
+    then takes an ``rng`` argument. Batched rows stop at the min
+    acceptance across the batch; truncating speculation early is
+    distribution-preserving (rows that accepted at the cutoff emit
+    their accepted proposal, not the residual).
 
     With ``return_stats=True`` the function returns ``(tokens,
     n_rounds)`` — the number of verify rounds (= target forwards) the
@@ -88,9 +103,17 @@ def make_speculative_generate_fn(
             f"target and draft must share a vocab; got {cfg.vocab} vs "
             f"{draft_cfg.vocab}"
         )
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     w = k_draft + 1  # verification window
+    sampled = temperature > 0.0
 
-    def generate(params, draft_params, prompt):
+    def generate(params, draft_params, prompt, rng=None):
+        if sampled and rng is None:
+            raise ValueError(
+                "temperature > 0 samples: pass rng=jax.random.PRNGKey(...) "
+                "(a silent fixed key would make every call identical)"
+            )
         b, s = prompt.shape
         if s < w:
             raise ValueError(
@@ -111,54 +134,128 @@ def make_speculative_generate_fn(
         def round_(carry):
             buf, t_cache, d_cache, pos, rounds = carry
             win = jax.lax.dynamic_slice(buf, (0, pos - w), (b, w))
+            # Fresh randomness per (round start, position): pos strictly
+            # advances each round, so folded keys never repeat even when
+            # a rejected position is re-proposed next round.
+            kr = jax.random.fold_in(rng, pos) if sampled else None
+
+            def pick(logits, key):
+                if not sampled:
+                    return jnp.argmax(logits, axis=-1).astype(buf.dtype)
+                return jax.random.categorical(
+                    key, logits / temperature, axis=-1
+                ).astype(buf.dtype)
 
             # Draft: window pass re-validates its cache and yields q_1;
-            # k_draft-1 single-token steps yield q_2..q_k.
+            # k_draft-1 single-token steps yield q_2..q_k (keeping the
+            # draft's log-probs for the acceptance ratios when sampling).
             d_logits, d_cache = forward_with_cache(
                 draft_params, win, d_cache, pos - w, draft_cfg
             )
-            q1 = jnp.argmax(d_logits[:, -1], axis=-1).astype(buf.dtype)
+            q1 = pick(d_logits[:, -1],
+                      jax.random.fold_in(kr, 1000) if sampled else None)
 
-            def d_step(c, _):
+            def d_step(c, i):
                 tok, cache, p = c
                 lg, cache = forward_with_cache(
                     draft_params, tok[:, None], cache, p, draft_cfg
                 )
-                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(buf.dtype)
-                return (nxt, cache, p + 1), nxt
+                nxt = pick(lg[:, -1],
+                           jax.random.fold_in(kr, 1001 + i)
+                           if sampled else None)
+                return (nxt, cache, p + 1), (nxt, lg[:, -1])
 
-            (_, d_cache, _), qs = jax.lax.scan(
-                d_step, (q1, d_cache, pos), None, length=k_draft - 1
+            (_, d_cache, _), (q_rest, d_lgs) = jax.lax.scan(
+                d_step, (q1, d_cache, pos), jnp.arange(k_draft - 1)
             )
-            q = jnp.concatenate(
-                [q1[:, None], jnp.moveaxis(qs, 0, 1)], axis=1
-            ) if k_draft > 1 else q1[:, None]                     # (B, k)
+            q = (jnp.concatenate(
+                [q1[:, None], jnp.moveaxis(q_rest, 0, 1)], axis=1
+            ) if k_draft > 1 else q1[:, None])                    # (B, k)
+            # Draft logits at the k proposal positions (B, k, V) — only
+            # the sampled path pays for materializing the stack.
+            d_stack = (jnp.concatenate(
+                [d_logits[:, -1:], jnp.moveaxis(d_lgs, 0, 1)], axis=1
+            ) if k_draft > 1 else d_logits[:, -1:]) if sampled else None
 
-            # Target: one forward over [window, q_1..q_k] — its logits at
-            # indices w-1..w+k-1 are the argmax choices for positions
-            # pos..pos+k given the proposals.
+            # Target: one forward over [window, q_1..q_k] — its logits
+            # at indices w-1..w+k-1 cover positions pos..pos+k given the
+            # proposals.
             t_in = jnp.concatenate([win, q], axis=1)
             t_logits, t_cache = forward_with_cache(
                 params, t_in, t_cache, pos - w, cfg
             )
-            t_pred = jnp.argmax(t_logits[:, w - 1:], axis=-1).astype(
-                buf.dtype
-            )                                                    # (B, k+1)
+            t_stack = t_logits[:, w - 1:]                      # (B, k+1, V)
 
-            # Longest prefix of proposals the target agrees with, min
-            # over batch rows (keeps `pos` scalar; see module docstring).
-            eq = (q == t_pred[:, :k_draft]).astype(jnp.int32)
-            n = jnp.min(jnp.cumprod(eq, axis=1).sum(axis=1))
+            if not sampled:
+                t_pred = jnp.argmax(t_stack, axis=-1).astype(buf.dtype)
+                # Longest prefix of proposals the target agrees with,
+                # min over batch rows (keeps `pos` scalar).
+                eq = (q == t_pred[:, :k_draft]).astype(jnp.int32)
+                n = jnp.min(jnp.cumprod(eq, axis=1).sum(axis=1))
+                correction = jnp.take_along_axis(
+                    t_pred, jnp.full((b, 1), n), axis=1
+                )[:, 0]
+            else:
+                t_lp = jax.nn.log_softmax(
+                    t_stack.astype(jnp.float32) / temperature, axis=-1
+                )
+                d_lp = jax.nn.log_softmax(
+                    d_stack.astype(jnp.float32) / temperature, axis=-1
+                )
+                qi = q[..., None].astype(jnp.int32)
+                lt_q = jnp.take_along_axis(t_lp[:, :k_draft], qi, -1)[..., 0]
+                ld_q = jnp.take_along_axis(d_lp, qi, -1)[..., 0]
+                u = jax.random.uniform(
+                    jax.random.fold_in(kr, 2), (b, k_draft)
+                )
+                accept = (
+                    jnp.log(jnp.maximum(u, 1e-38)) < (lt_q - ld_q)
+                ).astype(jnp.int32)                              # (B, k)
+                n_row = jnp.cumprod(accept, axis=1).sum(axis=1)
+                n = jnp.min(n_row)
+                # Correction at position pos+n: rows that rejected there
+                # resample from the residual max(p_t - p_d, 0); rows the
+                # batch-min merely cut short emit their accepted
+                # proposal; n == k means everyone accepted everything
+                # and the extra token samples straight from the target.
+                t_ln = jnp.take_along_axis(
+                    t_lp, jnp.full((b, 1, 1), n), axis=1
+                )[:, 0]                                           # (B, V)
+                d_ln = jnp.take_along_axis(
+                    d_lp, jnp.full((b, 1, 1), jnp.minimum(n, k_draft - 1)),
+                    axis=1,
+                )[:, 0]
+                pt, pd_ = jnp.exp(t_ln), jnp.exp(d_ln)
+                res = jnp.maximum(pt - pd_, 0.0)
+                z = res.sum(axis=-1, keepdims=True)
+                res_probs = jnp.where(z > 1e-30, res / jnp.maximum(z, 1e-30),
+                                      pt)
+                final_probs = jnp.where(n < k_draft, res_probs, pt)
+                sampled_corr = jax.random.categorical(
+                    jax.random.fold_in(kr, 3),
+                    jnp.log(jnp.maximum(final_probs, 1e-38)), axis=-1
+                ).astype(buf.dtype)
+                accepted_at_n = jnp.where(
+                    n < k_draft,
+                    jnp.take_along_axis(
+                        accept, jnp.full((b, 1), jnp.minimum(n, k_draft - 1)),
+                        axis=1,
+                    )[:, 0],
+                    jnp.zeros((b,), jnp.int32),
+                )
+                next_q = jnp.take_along_axis(
+                    q, jnp.full((b, 1), jnp.minimum(n, k_draft - 1)), axis=1
+                )[:, 0]
+                correction = jnp.where(
+                    accepted_at_n == 1, next_q, sampled_corr
+                )
 
-            # Emit q_1..q_n then the target's correction t_{n+1}. Slots
-            # past n are filled with proposals; a later round overwrites
-            # them before they can ever be part of the consumed prefix.
+            # Emit q_1..q_n then the correction. Slots past n hold
+            # proposals; a later round overwrites them before they can
+            # ever be part of the consumed prefix.
             idx = jnp.arange(k_draft + 1)[None, :]
             padded_q = jnp.concatenate([q, q[:, -1:]], axis=1)
-            correction = jnp.take_along_axis(
-                t_pred, jnp.full((b, 1), n), axis=1
-            )
-            emit = jnp.where(idx == n, correction, padded_q)
+            emit = jnp.where(idx == n, correction[:, None], padded_q)
             buf = jax.lax.dynamic_update_slice(buf, emit, (0, pos))
             return buf, t_cache, d_cache, pos + n + 1, rounds + 1
 
