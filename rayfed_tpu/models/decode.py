@@ -274,13 +274,20 @@ def make_beam_search_fn(
     *,
     max_new_tokens: int,
     n_beams: int,
+    eos_id: Optional[int] = None,
     jit: bool = True,
 ):
     """Build ``beam_search(params, prompt) -> (seqs, scores)``.
 
-    Fixed-length beam search (no EOS shortcut — every beam decodes
-    ``max_new_tokens``), returning ``seqs`` (B, n_beams, S+max_new) and
-    their total log-probabilities ``scores`` (B, n_beams), best first.
+    Beam search over ``max_new_tokens`` steps, returning ``seqs``
+    (B, n_beams, S+max_new) and their total log-probabilities ``scores``
+    (B, n_beams), best first. With ``eos_id`` set, a beam that emits it
+    is FINISHED: its score freezes and its remaining slots pad with
+    ``eos_id`` (the scored sequence is everything up to and including
+    the first EOS) — the result is the exact top-K over the space of
+    EOS-terminated-or-length-capped continuations when the beam is wide
+    enough (pinned against enumeration in tests). Without ``eos_id``
+    every beam decodes the full length.
 
     TPU-first shape: ONE compile for the whole search — the step body is
     a ``lax.scan`` whose carry holds the flattened (B*n_beams) decode
@@ -295,6 +302,8 @@ def make_beam_search_fn(
         raise ValueError("max_new_tokens must be >= 1")
     if n_beams < 1:
         raise ValueError("n_beams must be >= 1")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id must be in [0, {cfg.vocab}), got {eos_id}")
     k_beams = n_beams
     vocab = cfg.vocab
 
@@ -314,6 +323,10 @@ def make_beam_search_fn(
         scores = jnp.pad(scores0, ((0, 0), (0, pad)),
                          constant_values=-jnp.inf)
         first = jnp.pad(first0, ((0, 0), (0, pad))).astype(prompt.dtype)
+        finished = (
+            first == eos_id if eos_id is not None
+            else jnp.zeros(first.shape, bool)
+        )
 
         # Tile the cache to B*K rows: row b*K + j = beam j of batch b.
         cache = jax.tree_util.tree_map(
@@ -323,13 +336,20 @@ def make_beam_search_fn(
         seqs = seqs.at[:, :, 0].set(first)
 
         def step(carry, t):
-            tok, cache, seqs, scores = carry
+            tok, cache, seqs, scores, finished = carry
             logits, cache = forward_with_cache(
                 params, tok.reshape(b * k_beams, 1), cache, s + t, cfg
             )
             logp = jax.nn.log_softmax(
                 logits[:, -1].astype(jnp.float32), axis=-1
             ).reshape(b, k_beams, vocab)
+            if eos_id is not None:
+                # A finished beam survives UNCHANGED: its only candidate
+                # is "emit EOS again at zero cost", so its frozen score
+                # competes in the top-K and its trailing slots pad with
+                # EOS.
+                freeze = jnp.full((vocab,), -jnp.inf).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], freeze, logp)
             cand = scores[:, :, None] + logp           # (B, K, V)
             scores, flat = jax.lax.top_k(
                 cand.reshape(b, k_beams * vocab), k_beams
@@ -339,18 +359,21 @@ def make_beam_search_fn(
             # Reorder histories and cache rows under the surviving beams.
             seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
             seqs = seqs.at[:, :, t + 1].set(nxt)
+            if eos_id is not None:
+                finished = jnp.take_along_axis(finished, parent, axis=1)
+                finished = finished | (nxt == eos_id)
             rows = (
                 jnp.arange(b)[:, None] * k_beams + parent
             ).reshape(b * k_beams)
             cache = jax.tree_util.tree_map(
                 lambda c: jnp.take(c, rows, axis=1), cache
             )
-            return (nxt, cache, seqs, scores), None
+            return (nxt, cache, seqs, scores, finished), None
 
         if max_new_tokens > 1:
-            (_, _, seqs, scores), _ = jax.lax.scan(
+            (_, _, seqs, scores, _), _ = jax.lax.scan(
                 step,
-                (first, cache, seqs, scores),
+                (first, cache, seqs, scores, finished),
                 jnp.arange(max_new_tokens - 1),
             )
         prompts = jnp.broadcast_to(

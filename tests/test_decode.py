@@ -289,3 +289,64 @@ def test_beam_search_batched_rows_do_not_cross_contaminate():
         np.testing.assert_allclose(
             float(scores[row, 0]), ref_score, rtol=1e-4
         )
+
+
+def test_beam_search_eos_matches_exhaustive():
+    """With eos_id set and a wide-enough beam, the top beam must equal
+    the best sequence over the space of EOS-terminated-or-length-capped
+    continuations (each scored up to and including its first EOS)."""
+    import itertools
+
+    cfg = tfm.tiny_config(vocab=5, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(8), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (1, 5), 0, cfg.vocab)
+    eos, t_new = 0, 3
+
+    # Brute force: every full continuation, truncated at its first EOS
+    # (inclusive); dedupe truncated forms; keep the best score.
+    best = {}
+    for cont in itertools.product(range(cfg.vocab), repeat=t_new):
+        cut = t_new
+        for i, c in enumerate(cont):
+            if c == eos:
+                cut = i + 1
+                break
+        trunc = cont[:cut]
+        toks = jnp.concatenate(
+            [prompt, jnp.asarray([cont], jnp.int32)], axis=1
+        )
+        logp = jax.nn.log_softmax(
+            tfm.forward(params, toks, cfg).astype(jnp.float32), axis=-1
+        )
+        score = sum(
+            float(logp[0, prompt.shape[1] - 1 + i, trunc[i]])
+            for i in range(cut)
+        )
+        if trunc not in best or score > best[trunc]:
+            best[trunc] = score
+    ref_seq, ref_score = max(best.items(), key=lambda kv: kv[1])
+
+    bs = decode.make_beam_search_fn(
+        cfg, max_new_tokens=t_new, n_beams=cfg.vocab ** (t_new - 1),
+        eos_id=eos,
+    )
+    seqs, scores = bs(params, prompt)
+    got_full = [int(x) for x in np.asarray(seqs)[0, 0, prompt.shape[1]:]]
+    cut = t_new
+    for i, c in enumerate(got_full):
+        if c == eos:
+            cut = i + 1
+            break
+    assert tuple(got_full[:cut]) == ref_seq, (got_full, ref_seq)
+    # Trailing slots of a finished beam pad with EOS.
+    assert all(c == eos for c in got_full[cut:]), got_full
+    np.testing.assert_allclose(float(scores[0, 0]), ref_score, rtol=1e-4)
+
+
+def test_beam_search_eos_validates():
+    cfg = tfm.tiny_config()
+    with pytest.raises(ValueError, match="eos_id"):
+        decode.make_beam_search_fn(
+            cfg, max_new_tokens=2, n_beams=2, eos_id=cfg.vocab
+        )
