@@ -143,6 +143,7 @@ def make_generate_fn(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_id: Optional[int] = None,
     jit: bool = True,
     mesh: Optional[Mesh] = None,
     party_axis: Optional[str] = "party",
@@ -155,7 +156,9 @@ def make_generate_fn(
     ``top_k`` highest-probability tokens and/or the ``top_p`` nucleus
     (smallest set of tokens whose probability mass reaches ``top_p``).
     Lengths are static: the returned function compiles once per prompt
-    shape.
+    shape. With ``eos_id``, a row that emits it keeps emitting EOS for
+    the rest of the (static-length) generation — the output still has
+    shape (B, S+max_new), terminated rows are EOS-padded.
 
     With ``mesh``, decoding runs sharded: params follow the Megatron tp
     rules (:mod:`rayfed_tpu.parallel.sharding`), the prompt/batch shards
@@ -169,6 +172,8 @@ def make_generate_fn(
         raise ValueError(f"top_k must be in [1, {cfg.vocab}], got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id must be in [0, {cfg.vocab}), got {eos_id}")
     if temperature <= 0.0 and (top_k is not None or top_p is not None):
         raise ValueError(
             "top_k/top_p truncate the sampling distribution; with "
@@ -218,19 +223,26 @@ def make_generate_fn(
         last_logits, cache = prefill(params, prompt, cache, cfg)
         rng, sub = jax.random.split(rng)
         first = sample(last_logits, sub).astype(prompt.dtype)
+        done0 = (
+            first == eos_id if eos_id is not None
+            else jnp.zeros(first.shape, bool)
+        )
 
         def step(carry, _):
-            tok, cache, pos, key = carry
+            tok, cache, pos, key, done = carry
             logits, cache = forward_with_cache(
                 params, tok[:, None], cache, pos, cfg
             )
             key, sub = jax.random.split(key)
             nxt = sample(logits[:, -1], sub).astype(prompt.dtype)
-            return (nxt, cache, pos + 1, key), nxt
+            if eos_id is not None:
+                nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+                done = done | (nxt == eos_id)
+            return (nxt, cache, pos + 1, key, done), nxt
 
         _, toks = jax.lax.scan(
             step,
-            (first, cache, jnp.asarray(s, jnp.int32), rng),
+            (first, cache, jnp.asarray(s, jnp.int32), rng, done0),
             None,
             length=max_new_tokens - 1,
         )

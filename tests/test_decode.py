@@ -350,3 +350,52 @@ def test_beam_search_eos_validates():
         decode.make_beam_search_fn(
             cfg, max_new_tokens=2, n_beams=2, eos_id=cfg.vocab
         )
+
+
+def test_generate_eos_pads_terminated_rows():
+    """eos_id: tokens before the first EOS match the plain generation;
+    everything after the first EOS is EOS."""
+    cfg = tfm.tiny_config(vocab=5, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(10), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (3, 4), 0, cfg.vocab)
+    eos, t_new = 0, 8
+
+    # Sampled at a fixed key so rows actually hit EOS within the
+    # budget; both runs share the key, and the per-step key chain is
+    # identical regardless of termination, so the trajectories must
+    # agree up to each row's first EOS.
+    key = jax.random.PRNGKey(12)
+    plain = np.asarray(
+        decode.make_generate_fn(cfg, max_new_tokens=t_new, temperature=1.0)(
+            params, prompt, key
+        )
+    )
+    with_eos = np.asarray(
+        decode.make_generate_fn(
+            cfg, max_new_tokens=t_new, temperature=1.0, eos_id=eos
+        )(params, prompt, key)
+    )
+    s = prompt.shape[1]
+    terminated = 0
+    for row in range(prompt.shape[0]):
+        gen_plain, gen_eos = plain[row, s:], with_eos[row, s:]
+        cut = t_new
+        for i, c in enumerate(gen_plain):
+            if c == eos:
+                cut = i + 1
+                break
+        # Up to and including the first EOS the trajectories agree...
+        np.testing.assert_array_equal(gen_eos[:cut], gen_plain[:cut])
+        # ...and afterwards the eos_id variant pads with EOS.
+        assert all(c == eos for c in gen_eos[cut:]), gen_eos
+        terminated += cut < t_new
+    # vocab=5 over 8 steps: at least one row should actually terminate,
+    # otherwise this test exercised nothing (deterministic, seed-fixed).
+    assert terminated >= 1
+
+
+def test_generate_eos_validates():
+    cfg = tfm.tiny_config()
+    with pytest.raises(ValueError, match="eos_id"):
+        decode.make_generate_fn(cfg, max_new_tokens=2, eos_id=-1)
