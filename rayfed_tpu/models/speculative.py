@@ -50,6 +50,8 @@ and serve next to the aggregated target.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -64,6 +66,7 @@ def make_speculative_generate_fn(
     max_new_tokens: int,
     k_draft: int = 4,
     temperature: float = 0.0,
+    eos_id: Optional[int] = None,
     jit: bool = True,
     return_stats: bool = False,
 ):
@@ -72,7 +75,11 @@ def make_speculative_generate_fn(
     ``params``/``cfg`` are the target model, ``draft_params``/
     ``draft_cfg`` the proposal model (same vocab required). At the
     default ``temperature=0`` decoding is greedy and the result is
-    bit-for-bit the target's own greedy decode. Prompt length must be
+    bit-for-bit the target's own greedy decode. With ``eos_id``, a row
+    that emits EOS pads the rest of its (static-length) output with EOS
+    — exactly the semantics of
+    :func:`rayfed_tpu.models.decode.make_generate_fn`; EOS tokens
+    already inside the prompt are ignored. Prompt length must be
     at least ``k_draft + 1`` (the verification window).
 
     With ``temperature > 0`` the full rejection-sampling scheme runs
@@ -105,6 +112,8 @@ def make_speculative_generate_fn(
         )
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if eos_id is not None and not 0 <= eos_id < cfg.vocab:
+        raise ValueError(f"eos_id must be in [0, {cfg.vocab}), got {eos_id}")
     w = k_draft + 1  # verification window
     sampled = temperature > 0.0
 
@@ -132,7 +141,7 @@ def make_speculative_generate_fn(
         _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
 
         def round_(carry):
-            buf, t_cache, d_cache, pos, rounds = carry
+            buf, t_cache, d_cache, pos, rounds, done = carry
             win = jax.lax.dynamic_slice(buf, (0, pos - w), (b, w))
             # Fresh randomness per (round start, position): pos strictly
             # advances each round, so folded keys never repeat even when
@@ -256,16 +265,31 @@ def make_speculative_generate_fn(
             idx = jnp.arange(k_draft + 1)[None, :]
             padded_q = jnp.concatenate([q, q[:, -1:]], axis=1)
             emit = jnp.where(idx == n, correction[:, None], padded_q)
+            if eos_id is not None:
+                # EOS-pad within the emitted block (everything after a
+                # row's first EOS — matching make_generate_fn's padding)
+                # and across rounds (rows already done stay EOS).
+                past_eos = jnp.cumsum(
+                    (emit == eos_id).astype(jnp.int32), axis=1
+                ) - (emit == eos_id).astype(jnp.int32)
+                emit = jnp.where(
+                    (past_eos > 0) | done[:, None],
+                    jnp.asarray(eos_id, emit.dtype), emit,
+                )
+                # Only the consumed prefix of the block (n+1 tokens) can
+                # finish a row; speculative slots past it are junk.
+                consumed_eos = ((emit == eos_id) & (idx <= n)).any(axis=1)
+                done = done | consumed_eos
             buf = jax.lax.dynamic_update_slice(buf, emit, (0, pos))
-            return buf, t_cache, d_cache, pos + n + 1, rounds + 1
+            return buf, t_cache, d_cache, pos + n + 1, rounds + 1, done
 
         def cond(carry):
             return carry[3] < total
 
-        buf, _, _, _, rounds = jax.lax.while_loop(
+        buf, _, _, _, rounds, _ = jax.lax.while_loop(
             cond, round_,
             (buf, t_cache, d_cache, jnp.asarray(s, jnp.int32),
-             jnp.asarray(0, jnp.int32)),
+             jnp.asarray(0, jnp.int32), jnp.zeros((b,), bool)),
         )
         out = jax.lax.dynamic_slice(buf, (0, 0), (b, total))
         return (out, rounds) if return_stats else out

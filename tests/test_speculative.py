@@ -150,3 +150,35 @@ def test_sampled_speculative_validates_temperature():
     prompt = jnp.zeros((1, 4), jnp.int32)
     with pytest.raises(ValueError, match="rng"):
         spec(params, dparams, prompt)  # sampling without a key
+
+
+def test_speculative_eos_equals_target_greedy_eos():
+    """eos_id + greedy: speculative output must equal
+    make_generate_fn(eos_id=...)'s output exactly — terminated rows
+    EOS-padded, untouched rows decoded to full length. (Seeds chosen so
+    one prompt row terminates early and one never does.)"""
+    cfg = tfm.tiny_config(vocab=5, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, compute_dtype=jnp.float32)
+    dcfg = tfm.tiny_config(vocab=5, d_model=16, n_heads=2, n_layers=1,
+                           d_ff=32, compute_dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    dparams = tfm.init_params(jax.random.PRNGKey(5), dcfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(100), (2, 5), 0, cfg.vocab)
+    eos, t_new = 0, 8
+
+    ref = np.asarray(
+        decode.make_generate_fn(cfg, max_new_tokens=t_new, eos_id=eos)(
+            params, prompt
+        )
+    )
+    gen = ref[:, 5:]
+    assert any(eos in row.tolist() for row in gen), gen  # seeds still valid
+    assert any(eos not in row.tolist() for row in gen), gen
+
+    for draft in (dparams, params):  # imperfect and perfect drafts
+        spec = speculative.make_speculative_generate_fn(
+            cfg, dcfg if draft is dparams else cfg,
+            max_new_tokens=t_new, k_draft=3, eos_id=eos,
+        )
+        np.testing.assert_array_equal(np.asarray(spec(params, draft, prompt)),
+                                      ref)
