@@ -136,6 +136,36 @@ def prefill(params, prompt, cache: Cache, cfg: tfm.TransformerConfig):
     return logits[:, -1], cache
 
 
+def _sharded_jit(fn, mesh: Mesh, party_axis, data_axis, n_extra_args: int):
+    """jit ``fn(params, prompt, *extras)`` with Megatron param shardings
+    and a party x data prompt sharding, keyed per param-tree
+    structure/shapes/dtypes — a later call with a different tree (e.g.
+    LoRA-merged vs base) gets its own in_shardings instead of reusing
+    stale ones. Shared by the sharded generate and beam-search
+    dispatchers so the keying scheme cannot drift between them."""
+    from rayfed_tpu.parallel import sharding as shd
+
+    prompt_sharding = NamedSharding(
+        mesh, shd.batch_spec(mesh, party_axis, data_axis)
+    )
+    jitted_by_tree = {}
+
+    def dispatch(params, prompt, *extras):
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        key = (treedef, tuple((x.shape, x.dtype) for x in leaves))
+        jitted = jitted_by_tree.get(key)
+        if jitted is None:
+            param_shardings = shd.make_param_shardings(mesh, params)
+            jitted = jitted_by_tree[key] = jax.jit(
+                fn,
+                in_shardings=(param_shardings, prompt_sharding)
+                + (None,) * n_extra_args,
+            )
+        return jitted(params, prompt, *extras)
+
+    return dispatch
+
+
 def make_generate_fn(
     cfg: tfm.TransformerConfig,
     *,
@@ -254,27 +284,10 @@ def make_generate_fn(
     if mesh is None:
         return jax.jit(generate)
 
-    from rayfed_tpu.parallel import sharding as shd
-
-    prompt_sharding = NamedSharding(
-        mesh, shd.batch_spec(mesh, party_axis, data_axis)
-    )
-    # Jitted fns are keyed on the param tree's structure/shapes/dtypes:
-    # a later call with a different tree (e.g. LoRA-merged vs base) gets
-    # its own in_shardings instead of reusing stale ones.
-    jitted_by_tree = {}
+    dispatch = _sharded_jit(generate, mesh, party_axis, data_axis, 1)
 
     def sharded_generate(params, prompt, rng: Optional[jax.Array] = None):
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = (treedef, tuple((x.shape, x.dtype) for x in leaves))
-        jitted = jitted_by_tree.get(key)
-        if jitted is None:
-            param_shardings = shd.make_param_shardings(mesh, params)
-            jitted = jitted_by_tree[key] = jax.jit(
-                generate,
-                in_shardings=(param_shardings, prompt_sharding, None),
-            )
-        return jitted(
+        return dispatch(
             params, prompt, rng if rng is not None else jax.random.PRNGKey(0)
         )
 
@@ -288,6 +301,9 @@ def make_beam_search_fn(
     n_beams: int,
     eos_id: Optional[int] = None,
     jit: bool = True,
+    mesh: Optional[Mesh] = None,
+    party_axis: Optional[str] = "party",
+    data_axis: Optional[str] = "data",
 ):
     """Build ``beam_search(params, prompt) -> (seqs, scores)``.
 
@@ -300,6 +316,12 @@ def make_beam_search_fn(
     EOS-terminated-or-length-capped continuations when the beam is wide
     enough (pinned against enumeration in tests). Without ``eos_id``
     every beam decodes the full length.
+
+    With ``mesh``, the search runs sharded exactly like
+    :func:`make_generate_fn`: Megatron-tp params, the prompt batch over
+    party x data, and the K/V cache pinned by :func:`cache_spec` (its
+    batch dim is B*n_beams rows; beam reordering is a batched gather
+    XLA turns into on-device collectives where rows cross shards).
 
     TPU-first shape: ONE compile for the whole search — the step body is
     a ``lax.scan`` whose carry holds the flattened (B*n_beams) decode
@@ -318,10 +340,25 @@ def make_beam_search_fn(
         raise ValueError(f"eos_id must be in [0, {cfg.vocab}), got {eos_id}")
     k_beams = n_beams
     vocab = cfg.vocab
+    cache_sharding = None
+    if mesh is not None:
+        cache_sharding = NamedSharding(
+            mesh, cache_spec(mesh, party_axis, data_axis)
+        )
 
     def beam_search(params, prompt):
         b, s = prompt.shape
         cache = init_cache(cfg, b, s + max_new_tokens - 1)
+        if cache_sharding is not None:
+            # Pin the layout BEFORE prefill (like make_generate_fn) so
+            # GSPMD cannot pick a different prefill-time layout and
+            # reshard the whole stack at the tile below.
+            cache = jax.tree_util.tree_map(
+                lambda c: jax.lax.with_sharding_constraint(
+                    c, cache_sharding
+                ),
+                cache,
+            )
         last_logits, cache = prefill(params, prompt, cache, cfg)
         logp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
 
@@ -344,6 +381,13 @@ def make_beam_search_fn(
         cache = jax.tree_util.tree_map(
             lambda c: jnp.repeat(c, k_beams, axis=1), cache
         )
+        if cache_sharding is not None:
+            cache = jax.tree_util.tree_map(
+                lambda c: jax.lax.with_sharding_constraint(
+                    c, cache_sharding
+                ),
+                cache,
+            )
         seqs = jnp.zeros((b, k_beams, max_new_tokens), prompt.dtype)
         seqs = seqs.at[:, :, 0].set(first)
 
@@ -393,4 +437,9 @@ def make_beam_search_fn(
         ).astype(prompt.dtype)
         return jnp.concatenate([prompts, seqs], axis=2), scores
 
-    return jax.jit(beam_search) if jit else beam_search
+    if not jit:
+        return beam_search
+    if mesh is None:
+        return jax.jit(beam_search)
+
+    return _sharded_jit(beam_search, mesh, party_axis, data_axis, 0)

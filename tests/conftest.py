@@ -70,6 +70,7 @@ _SLOW_TESTS = {
     "test_beam_search_beam1_is_greedy",
     "test_beam_search_batched_rows_do_not_cross_contaminate",
     "test_beam_search_eos_matches_exhaustive",
+    "test_sharded_beam_search_matches_single_device",
     "test_speculative_equals_target_greedy",
     "test_speculative_with_perfect_draft",
     "test_sampled_speculative_matches_exact_target_distribution",

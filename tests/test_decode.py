@@ -399,3 +399,33 @@ def test_generate_eos_validates():
     cfg = tfm.tiny_config()
     with pytest.raises(ValueError, match="eos_id"):
         decode.make_generate_fn(cfg, max_new_tokens=2, eos_id=-1)
+
+
+def test_sharded_beam_search_matches_single_device():
+    """Beam search over a data x model mesh (tp params, head-sharded
+    B*n_beams cache rows) must reproduce the unsharded beams exactly —
+    sequences AND scores."""
+    from jax.sharding import Mesh
+
+    from rayfed_tpu.parallel import sharding as shd
+
+    cfg = _cfg(n_heads=4)
+    params = tfm.init_params(jax.random.PRNGKey(30), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(31), (4, 6), 0, cfg.vocab)
+
+    ref_seqs, ref_scores = decode.make_beam_search_fn(
+        cfg, max_new_tokens=4, n_beams=3, eos_id=0
+    )(params, prompt)
+
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("data", "model"))
+    sharded_params = shd.shard_params(mesh, params)
+    bs = decode.make_beam_search_fn(
+        cfg, max_new_tokens=4, n_beams=3, eos_id=0, mesh=mesh
+    )
+    seqs, scores = bs(sharded_params, prompt)
+
+    np.testing.assert_array_equal(np.asarray(seqs), np.asarray(ref_seqs))
+    np.testing.assert_allclose(
+        np.asarray(scores), np.asarray(ref_scores), rtol=1e-5, atol=1e-6
+    )
