@@ -51,15 +51,20 @@ def cache_spec(
     party_axis: Optional[str] = "party",
     data_axis: Optional[str] = "data",
     model_axis: Optional[str] = "model",
+    n_heads: Optional[int] = None,
 ) -> P:
     """PartitionSpec for the stacked (L, B, T, H, Dh) K/V cache: batch over
     party x data, heads over the tensor-parallel axis — the same layout the
     Megatron rules give the attention activations, so cached decode runs
-    with zero resharding against tp-sharded parameters."""
+    with zero resharding against tp-sharded parameters. Pass ``n_heads``
+    to replicate the head dim when it does not divide the model axis
+    (e.g. a tiny draft model on a wide tp mesh)."""
     from rayfed_tpu.parallel import sharding as shd
 
     batch = shd.batch_spec(mesh, party_axis, data_axis)[0]
     heads = model_axis if model_axis in mesh.axis_names else None
+    if heads is not None and n_heads is not None and             n_heads % mesh.shape[model_axis] != 0:
+        heads = None
     return P(None, batch, None, heads, None)
 
 
@@ -136,13 +141,15 @@ def prefill(params, prompt, cache: Cache, cfg: tfm.TransformerConfig):
     return logits[:, -1], cache
 
 
-def _sharded_jit(fn, mesh: Mesh, party_axis, data_axis, n_extra_args: int):
-    """jit ``fn(params, prompt, *extras)`` with Megatron param shardings
-    and a party x data prompt sharding, keyed per param-tree
-    structure/shapes/dtypes — a later call with a different tree (e.g.
-    LoRA-merged vs base) gets its own in_shardings instead of reusing
-    stale ones. Shared by the sharded generate and beam-search
-    dispatchers so the keying scheme cannot drift between them."""
+def _sharded_jit(fn, mesh: Mesh, party_axis, data_axis, n_extra_args: int,
+                 n_param_trees: int = 1):
+    """jit ``fn(*param_trees, prompt, *extras)`` with Megatron param
+    shardings for each leading param tree and a party x data prompt
+    sharding, keyed per tree structure/shapes/dtypes — a later call with
+    a different tree (e.g. LoRA-merged vs base) gets its own
+    in_shardings instead of reusing stale ones. Shared by the sharded
+    generate / beam-search / speculative dispatchers so the keying
+    scheme cannot drift between them."""
     from rayfed_tpu.parallel import sharding as shd
 
     prompt_sharding = NamedSharding(
@@ -150,18 +157,24 @@ def _sharded_jit(fn, mesh: Mesh, party_axis, data_axis, n_extra_args: int):
     )
     jitted_by_tree = {}
 
-    def dispatch(params, prompt, *extras):
-        leaves, treedef = jax.tree_util.tree_flatten(params)
-        key = (treedef, tuple((x.shape, x.dtype) for x in leaves))
+    def tree_key(tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (treedef, tuple((x.shape, x.dtype) for x in leaves))
+
+    def dispatch(*args):
+        trees, rest = args[:n_param_trees], args[n_param_trees:]
+        key = tuple(tree_key(t) for t in trees)
         jitted = jitted_by_tree.get(key)
         if jitted is None:
-            param_shardings = shd.make_param_shardings(mesh, params)
+            shardings = tuple(
+                shd.make_param_shardings(mesh, t) for t in trees
+            )
             jitted = jitted_by_tree[key] = jax.jit(
                 fn,
-                in_shardings=(param_shardings, prompt_sharding)
+                in_shardings=shardings + (prompt_sharding,)
                 + (None,) * n_extra_args,
             )
-        return jitted(params, prompt, *extras)
+        return jitted(*args)
 
     return dispatch
 

@@ -69,6 +69,10 @@ def make_speculative_generate_fn(
     eos_id: Optional[int] = None,
     jit: bool = True,
     return_stats: bool = False,
+    mesh=None,
+    party_axis: Optional[str] = "party",
+    data_axis: Optional[str] = "data",
+    model_axis: Optional[str] = "model",
 ):
     """Build ``generate(params, draft_params, prompt) -> (B, S+max_new)``.
 
@@ -94,6 +98,13 @@ def make_speculative_generate_fn(
     distribution-preserving (rows that accepted at the cutoff emit
     their accepted proposal, not the residual).
 
+    With ``mesh``, both models run sharded like
+    :func:`rayfed_tpu.models.decode.make_generate_fn`: Megatron tp
+    params for target AND draft (both trees must satisfy the rules'
+    divisibility on the mesh), prompt batch over party x data, each
+    model's K/V cache head-sharded where its head count divides the
+    ``model`` axis (cache heads replicate otherwise).
+
     With ``return_stats=True`` the function returns ``(tokens,
     n_rounds)`` — the number of verify rounds (= target forwards) the
     generation took: ``max_new_tokens / n_rounds`` is the realized
@@ -117,6 +128,21 @@ def make_speculative_generate_fn(
     w = k_draft + 1  # verification window
     sampled = temperature > 0.0
 
+    def _cache_sharding(model_cfg):
+        if mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        from rayfed_tpu.models.decode import cache_spec
+
+        return NamedSharding(mesh, cache_spec(
+            mesh, party_axis, data_axis, model_axis,
+            n_heads=model_cfg.n_heads,
+        ))
+
+    t_cache_sh = _cache_sharding(cfg)
+    d_cache_sh = _cache_sharding(draft_cfg)
+
     def generate(params, draft_params, prompt, rng=None):
         if sampled and rng is None:
             raise ValueError(
@@ -137,6 +163,14 @@ def make_speculative_generate_fn(
         buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
         t_cache = init_cache(cfg, b, cap)
         d_cache = init_cache(draft_cfg, b, cap)
+        if mesh is not None:
+            constrain = jax.lax.with_sharding_constraint
+            t_cache = jax.tree_util.tree_map(
+                lambda c: constrain(c, t_cache_sh), t_cache
+            )
+            d_cache = jax.tree_util.tree_map(
+                lambda c: constrain(c, d_cache_sh), d_cache
+            )
         _, t_cache = prefill(params, prompt, t_cache, cfg)
         _, d_cache = prefill(draft_params, prompt, d_cache, draft_cfg)
 
@@ -294,4 +328,29 @@ def make_speculative_generate_fn(
         out = jax.lax.dynamic_slice(buf, (0, 0), (b, total))
         return (out, rounds) if return_stats else out
 
-    return jax.jit(generate) if jit else generate
+    if not jit:
+        return generate
+    if mesh is None:
+        return jax.jit(generate)
+
+    from rayfed_tpu.models.decode import _sharded_jit
+
+    dispatch = _sharded_jit(
+        generate, mesh, party_axis, data_axis,
+        n_extra_args=1, n_param_trees=2,
+    )
+
+    def sharded_generate(params, draft_params, prompt, rng=None):
+        if sampled and rng is None:
+            # generate() raises the same error at trace time; surface it
+            # before jit dispatch for a cleaner traceback.
+            raise ValueError(
+                "temperature > 0 samples: pass rng=jax.random.PRNGKey(...) "
+                "(a silent fixed key would make every call identical)"
+            )
+        return dispatch(
+            params, draft_params, prompt,
+            rng if rng is not None else jax.random.PRNGKey(0),
+        )
+
+    return sharded_generate

@@ -75,6 +75,8 @@ _SLOW_TESTS = {
     "test_speculative_with_perfect_draft",
     "test_sampled_speculative_matches_exact_target_distribution",
     "test_speculative_eos_equals_target_greedy_eos",
+    "test_sharded_speculative_matches_single_device",
+    "test_sharded_sampled_speculative_runs_and_is_deterministic",
     "test_fed_train_step_dp_tp",
     "test_remat_matches_non_remat",
     "test_pp_grads_match_serial",
