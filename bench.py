@@ -43,6 +43,10 @@ import time
 PAYLOAD_MB = 100
 ROUNDS = 5
 REPS = 8  # best-of-N inside one job (single-core hosts are noisy)
+# The paired-ceiling stage records more pairs: its headline is a MEDIAN
+# ratio, and hypervisor steal bursts (one per ~30s observed) each poison
+# a pair — 12 pairs keep the median in the steady-state regime.
+PAIRED_REPS = 12
 
 _FAST_RETRY = {
     "retry_policy": {
@@ -54,7 +58,8 @@ _FAST_RETRY = {
 }
 
 
-def _party_main(party, addresses, transport, result_path, device_dma=False):
+def _party_main(party, addresses, transport, result_path, device_dma=False,
+                pair_ceiling=False):
     import numpy as np
 
     import rayfed_tpu as fed
@@ -103,12 +108,77 @@ def _party_main(party, addresses, transport, result_path, device_dma=False):
     def barrier(*xs):
         return len(xs)
 
-    # Warmup round (connection setup, allocator warm).
+    @fed.remote
+    def tell_port(p):
+        return p
+
+    # Connection warmup (the measurement loop below carries its own
+    # discarded warmup cycles).
     w = consume.party("bob").remote(produce.party("alice").remote(-1.0))
     assert fed.get(w) == -2.0
 
+    # Paired-ceiling rig: a dedicated raw socket between the SAME two
+    # party processes. Each rep runs a raw sendall/recv_into window
+    # immediately before the lane window, so every lane sample gets a
+    # ceiling sample measured seconds apart under the same host regime —
+    # on this class of shared VM, throughput swings 2-3x on a seconds
+    # timescale (hypervisor steal), so a ceiling probed minutes away
+    # (round-4 methodology) calibrates a different regime than the stage
+    # it normalizes. pct_of_ceiling is the MEDIAN of per-rep ratios.
+    # The rig is best-effort: any failure here or in a raw window below
+    # degrades to lane-only reps (no ceiling keys) — the diagnostic
+    # ceiling must never abort the headline measurement. A failure on
+    # one side closes the raw socket, which breaks the peer's blocked
+    # window immediately (RST on close-with-unread-data), so both sides
+    # fall back in the same rep without desyncing the fed loop.
+    raw_sock = None
+    raw_nbytes = PAYLOAD_MB * 1024 * 1024
+    if pair_ceiling:
+        try:
+            if party == "bob":
+                raw_srv = socket.socket()
+                raw_srv.bind(("127.0.0.1", 0))
+                raw_srv.listen(1)
+                raw_srv.settimeout(60)
+                raw_port = raw_srv.getsockname()[1]
+            else:
+                raw_port = 0
+        except OSError:
+            raw_port = -1
+        # Multi-controller port exchange: the task runs at bob with bob's
+        # local value; alice's argument is a placeholder.
+        port_obj = tell_port.party("bob").remote(raw_port)
+        raw_port = fed.get(port_obj)
+        try:
+            if raw_port < 0:
+                raise OSError("peer has no raw listener")
+            if party == "alice":
+                raw_sock = socket.create_connection(
+                    ("127.0.0.1", raw_port), timeout=60
+                )
+                raw_sock.settimeout(None)
+                _tune(raw_sock)
+                raw_buf = bytearray(raw_nbytes)
+            else:
+                raw_sock, _ = raw_srv.accept()
+                raw_srv.close()
+                raw_sock.settimeout(None)
+                _tune(raw_sock)
+                raw_view = memoryview(bytearray(raw_nbytes))
+        except OSError as e:
+            print(f"paired ceiling rig unavailable: {e!r}", file=sys.stderr)
+            raw_sock = None
+
+    # Negative reps are warmup cycles with the IDENTICAL per-rep
+    # structure (produce, barrier, raw window, lane window), discarded
+    # from the stats. Measured: the lane needs ~3 full cycles before its
+    # allocator/scheduler steady state — single-push warmups left the
+    # first 2-3 timed reps 2-5x slow in every run on this host class.
     samples = []
-    for rep in range(REPS):
+    raw_samples = []
+    warmup_reps = 3
+    n_reps = PAIRED_REPS if pair_ceiling else REPS
+    for rep in range(-warmup_reps, n_reps):
         # Materialize all tensors at alice BEFORE the timed window so the
         # measurement is transport throughput, not producer memset speed.
         base = 100.0 * rep
@@ -116,19 +186,84 @@ def _party_main(party, addresses, transport, result_path, device_dma=False):
         ready = barrier.party("alice").remote(*tensors)
         assert fed.get(ready) == ROUNDS
 
+        if raw_sock is not None:
+            # Raw window: same bytes, same window structure, same two
+            # processes, right before the lane window it calibrates. Uses
+            # the strongest IO primitive available (the C++ fastwire
+            # calls — one GIL-released call per payload) so the ceiling
+            # is a true best-possible socket loop, not a Python recv_into
+            # loop the native lane can beat.
+            try:
+                if party == "alice":
+                    for _ in range(ROUNDS):
+                        _raw_send(raw_sock, raw_buf)
+                else:
+                    t0 = time.perf_counter()
+                    for _ in range(ROUNDS):
+                        _raw_recv(raw_sock, raw_view)
+                    if rep >= 0:
+                        raw_samples.append(
+                            ROUNDS * PAYLOAD_MB / 1024
+                            / (time.perf_counter() - t0)
+                        )
+            except (OSError, ConnectionError, TimeoutError) as e:
+                print(
+                    f"paired ceiling dropped mid-run: {e!r}", file=sys.stderr
+                )
+                try:
+                    raw_sock.close()
+                except OSError:
+                    pass
+                raw_sock = None
+                raw_samples = []  # partial pairing would skew the ratio
+
         t0 = time.perf_counter()
         outs = [consume.party("bob").remote(t) for t in tensors]
         checks = fed.get(outs)
         dt = time.perf_counter() - t0
         assert checks == [2.0 * (base + i) for i in range(ROUNDS)], checks
-        samples.append(ROUNDS * PAYLOAD_MB / 1024 / dt)
+        if rep >= 0:
+            samples.append(ROUNDS * PAYLOAD_MB / 1024 / dt)
 
+    if raw_sock is not None:
+        try:
+            raw_sock.close()
+        except OSError:
+            pass
     # Peak-of-reps: throughput capability, same rule for both lanes.
     gbps = max(samples)
     if party == "bob":
         with open(result_path, "w") as f:
-            json.dump({"gbps": gbps, "samples": samples}, f)
+            json.dump(
+                {"gbps": gbps, "samples": samples,
+                 "raw_samples": raw_samples},
+                f,
+            )
     fed.shutdown()
+
+
+def _raw_send(sock, buf) -> None:
+    try:
+        from rayfed_tpu import _fastwire
+
+        _fastwire.sendv(sock.fileno(), -1, [buf])
+    except ImportError:
+        sock.sendall(buf)
+
+
+def _raw_recv(sock, view) -> None:
+    try:
+        from rayfed_tpu import _fastwire
+
+        _fastwire.recv_exact(sock.fileno(), -1, view)
+    except ImportError:
+        n = view.nbytes
+        got = 0
+        while got < n:
+            k = sock.recv_into(view[got:], n - got)
+            if not k:
+                raise ConnectionError("raw ceiling sender died")
+            got += k
 
 
 def _free_ports(n):
@@ -141,46 +276,28 @@ def _free_ports(n):
     return ports
 
 
-def run_transport(transport: str, device_dma: bool = False) -> dict:
-    p1, p2 = _free_ports(2)
-    addresses = {"alice": f"127.0.0.1:{p1}", "bob": f"127.0.0.1:{p2}"}
-    mp = multiprocessing.get_context("spawn")
-    with tempfile.TemporaryDirectory() as tmp:
-        result_path = os.path.join(tmp, "result.json")
-        procs = [
-            mp.Process(
-                target=_party_main,
-                args=(party, addresses, transport, result_path, device_dma),
-            )
-            for party in ("alice", "bob")
-        ]
-        for p in procs:
-            p.start()
-        for p in procs:
-            p.join(timeout=600)
-        hung = [p for p in procs if p.is_alive()]
-        for p in hung:
-            p.terminate()  # a live non-daemon child would hang exit
-            p.join(timeout=30)
-        if hung:
-            raise RuntimeError(f"{transport} bench party hung; terminated")
-        for p in procs:
-            if p.exitcode != 0:
-                raise RuntimeError(
-                    f"{transport} bench party failed (exitcode={p.exitcode})"
-                )
-        with open(result_path) as f:
-            res = json.load(f)
-        import statistics
+def run_transport(transport: str, device_dma: bool = False,
+                  pair_ceiling: bool = False) -> dict:
+    res = _run_two_party(
+        _party_main, transport, (device_dma, pair_ceiling), timeout_s=600
+    )
+    import statistics
 
-        # max = capability (continuity with earlier rounds); median is
-        # robust to the start-clock skew between the two party processes,
-        # which can inflate individual short timed windows.
-        return {
-            "max": res["gbps"],
-            "median": statistics.median(res["samples"]),
-            "samples": res["samples"],
-        }
+    # max = capability (continuity with earlier rounds); median is
+    # robust to the start-clock skew between the two party processes,
+    # which can inflate individual short timed windows.
+    out = {
+        "max": res["gbps"],
+        "median": statistics.median(res["samples"]),
+        "samples": res["samples"],
+    }
+    raw = res.get("raw_samples") or []
+    if raw and len(raw) == len(res["samples"]):
+        ratios = [s / r for s, r in zip(res["samples"], raw) if r > 0]
+        out["raw_median"] = statistics.median(raw)
+        out["raw_spread"] = [min(raw), max(raw)]
+        out["paired_ratio_median"] = statistics.median(ratios)
+    return out
 
 
 def _tune(sock) -> None:
@@ -194,78 +311,6 @@ def _tune(sock) -> None:
         sockio.tune_socket(sock)
     except Exception:  # noqa: BLE001 - probe still works untuned
         pass
-
-
-def _ceiling_tx(port: int, n: int, reps: int) -> None:
-    """Sender half of the loopback-ceiling probe (own OS process, like a
-    bench party)."""
-    # Import the tuning helper BEFORE connecting: the first rayfed_tpu
-    # import takes seconds on a busy host, and the receiver's first
-    # timed window must not absorb it.
-    try:
-        from rayfed_tpu.proxy.tcp import sockio  # noqa: F401
-    except Exception:  # noqa: BLE001
-        pass
-    buf = bytearray(n)
-    s = socket.socket()
-    s.connect(("127.0.0.1", port))
-    _tune(s)
-    with s:
-        for _ in range(reps):
-            for _ in range(ROUNDS):
-                s.sendall(buf)
-
-
-def _loopback_ceiling() -> dict:
-    """The host's raw-socket loopback throughput as {"max", "median"}
-    over REPS reps of ROUNDS x payload timed windows (same methodology
-    and socket tuning as the transport benchmark; sender in its own
-    spawned process, recv_into a pinned buffer, nothing else on the
-    wire). The output JSON reports the MEDIAN. The push
-    benchmark's number is only meaningful relative to this: on a
-    single-core host the ceiling sits far below the NIC-less ideal
-    because sender and receiver share the core, and it drifts with
-    allocation noise — so it is re-measured at bench time, not quoted
-    from a past run (BASELINE.md's 2.8 GB/s was measured on a quieter
-    allocation and does not reproduce)."""
-    n = PAYLOAD_MB * 1024 * 1024
-    samples = []
-    srv = socket.socket()
-    proc = None
-    try:
-        srv.bind(("127.0.0.1", 0))
-        srv.listen(1)
-        port = srv.getsockname()[1]
-        mp = multiprocessing.get_context("spawn")
-        proc = mp.Process(target=_ceiling_tx, args=(port, n, REPS))
-        proc.start()
-        srv.settimeout(60)
-        conn, _ = srv.accept()
-        _tune(conn)
-        with conn:
-            view = memoryview(bytearray(n))
-            for _ in range(REPS):
-                t0 = time.perf_counter()
-                for _ in range(ROUNDS):
-                    got = 0
-                    while got < n:
-                        k = conn.recv_into(view[got:], n - got)
-                        if not k:
-                            raise ConnectionError("ceiling sender died")
-                        got += k
-                samples.append(ROUNDS * n / 2**30 / (time.perf_counter() - t0))
-    finally:
-        srv.close()
-        if proc is not None:
-            proc.join(timeout=30)
-            if proc.is_alive():
-                proc.terminate()
-                proc.join(timeout=10)
-    if not samples:
-        return {"max": 0.0, "median": 0.0}
-    import statistics
-
-    return {"max": max(samples), "median": statistics.median(samples)}
 
 
 def _try_dma_transport() -> Optional[float]:
@@ -297,6 +342,183 @@ def _try_dma_transport() -> Optional[float]:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def _tiny_party(party, addresses, transport, result_path, rounds):
+    import rayfed_tpu as fed
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        job_name=f"bench-tiny-{transport}",
+        logging_level="error",
+    )
+
+    @fed.remote
+    def inc(x):
+        return x + 1
+
+    @fed.remote
+    def aggregate(a, b):
+        return a + b
+
+    # Warmup (connection + executor spin-up).
+    fed.get(aggregate.party("alice").remote(
+        inc.party("alice").remote(0), inc.party("bob").remote(0)))
+
+    t0 = time.perf_counter()
+    acc = 0
+    for _ in range(rounds):
+        a = inc.party("alice").remote(acc)
+        b = inc.party("bob").remote(acc)
+        acc = fed.get(aggregate.party("alice").remote(a, b))
+    dt = time.perf_counter() - t0
+    # 3 fed tasks + 1 get per round (the reference harness's accounting,
+    # ref benchmarks/many_tiny_tasks_benchmark.py:48-59).
+    if party == "alice":
+        with open(result_path, "w") as f:
+            json.dump({"per_task_ms": dt / rounds / 3 * 1000}, f)
+    fed.shutdown()
+
+
+def _fedavg_party(party, addresses, transport, result_path, rounds):
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.federated import FedAvgTrainer
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        job_name=f"bench-fedavg-{transport}",
+        logging_level="error",
+    )
+
+    dim, classes, batch = 784, 10, 128  # MNIST logreg shapes (BASELINE #3)
+
+    @fed.remote
+    class Worker:
+        def __init__(self, seed):
+            rng = np.random.default_rng(seed)
+            self.w = np.zeros((dim, classes), np.float32)
+            self.b = np.zeros((classes,), np.float32)
+            self.x = rng.normal(size=(batch, dim)).astype(np.float32)
+            self.y = np.eye(classes, dtype=np.float32)[
+                rng.integers(0, classes, size=(batch,))
+            ]
+
+        def train(self, global_params):
+            if global_params is not None:
+                self.w, self.b = global_params
+            for _ in range(3):  # local epochs (plain numpy: the round
+                # latency under measurement is orchestration + transport)
+                logits = self.x @ self.w + self.b
+                logits -= logits.max(axis=1, keepdims=True)
+                p = np.exp(logits)
+                p /= p.sum(axis=1, keepdims=True)
+                g = (p - self.y) / batch
+                self.w -= 0.1 * (self.x.T @ g)
+                self.b -= 0.1 * g.sum(axis=0)
+            return (self.w, self.b)
+
+    trainer = FedAvgTrainer(
+        Worker, ["alice", "bob"],
+        worker_args={"alice": (1,), "bob": (2,)},
+    )
+    # Warmup round (actor init, first push).
+    global_params = fed.get(trainer.run(1))
+    t0 = time.perf_counter()
+    final = fed.get(trainer.run(rounds, global_params))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(np.asarray(final[0]).sum())
+    if party == "alice":
+        with open(result_path, "w") as f:
+            json.dump({"round_ms": dt / rounds * 1000}, f)
+    fed.shutdown()
+
+
+def _run_two_party(target, transport, extra_args, timeout_s=300) -> dict:
+    """Generic 2-party spawn harness: run ``target(party, addresses,
+    transport, result_path, *extra_args)`` in two processes; return the
+    result dict the writer party left at result_path."""
+    p1, p2 = _free_ports(2)
+    addresses = {"alice": f"127.0.0.1:{p1}", "bob": f"127.0.0.1:{p2}"}
+    mp = multiprocessing.get_context("spawn")
+    with tempfile.TemporaryDirectory() as tmp:
+        result_path = os.path.join(tmp, "result.json")
+        procs = [
+            mp.Process(
+                target=target,
+                args=(party, addresses, transport, result_path) + extra_args,
+            )
+            for party in ("alice", "bob")
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=timeout_s)
+        hung = [p for p in procs if p.is_alive()]
+        for p in hung:
+            p.terminate()
+            p.join(timeout=30)
+        if hung:
+            raise RuntimeError("bench party hung; terminated")
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(f"bench party failed ({p.exitcode})")
+        with open(result_path) as f:
+            return json.load(f)
+
+
+def _try_tiny_tasks():
+    """Per-task overhead (BASELINE config #1) on the native lane and the
+    reference-parity gRPC lane; keys land in the driver's JSON so
+    round-over-round regressions are visible (VERDICT r4 #3)."""
+    out = {}
+    try:
+        rounds = int(os.environ.get("FEDTPU_BENCH_TINY_ROUNDS", 300))
+        res = _run_two_party(_tiny_party, "tcp", (rounds,))
+        out["tiny_task_overhead_ms"] = round(res["per_task_ms"], 3)
+        res = _run_two_party(_tiny_party, "grpc", (rounds,))
+        out["tiny_task_overhead_grpc_ms"] = round(res["per_task_ms"], 3)
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"tiny-task bench skipped: {e!r}", file=sys.stderr)
+    return out
+
+
+def _try_fedavg():
+    """2-party FedAvg logistic-regression round latency (BASELINE config
+    #3) on the native and gRPC-parity lanes (VERDICT r4 #3).
+
+    Parties are forced onto the CPU jax backend (the aggregation helpers
+    are jitted): two processes cannot share the driver's single chip, and
+    a wedged accelerator tunnel must not hang the spawned children —
+    round latency here measures orchestration + transport."""
+    out = {}
+    scrub = {"PALLAS_AXON_POOL_IPS": None, "JAX_PLATFORMS": "cpu"}
+    saved = {k: os.environ.get(k) for k in scrub}
+    try:
+        for k, v in scrub.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        rounds = int(os.environ.get("FEDTPU_BENCH_FEDAVG_ROUNDS", 20))
+        res = _run_two_party(_fedavg_party, "tcp", (rounds,))
+        out["fedavg_round_ms"] = round(res["round_ms"], 2)
+        res = _run_two_party(_fedavg_party, "grpc", (rounds,))
+        out["fedavg_round_grpc_ms"] = round(res["round_ms"], 2)
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"fedavg bench skipped: {e!r}", file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
 
 
 def _try_build_fastwire() -> None:
@@ -452,28 +674,16 @@ def _try_train_mfu():
 def main() -> None:
     _try_build_fastwire()
     mfu = _try_train_mfu()
-    # Ceiling probes BRACKET the native measurement: this host's loopback
-    # throughput shifts regimes by tens of percent over minutes (observed
-    # medians 2.0-3.2 GiB/s across one bench run), so a single probe can
-    # land in a different regime than the stage it calibrates; the
-    # bracket's mean is the fairest available denominator and its spread
-    # is recorded so the ratio's noise is visible.
-    def _ceiling_safe():
-        try:
-            return _loopback_ceiling()
-        except Exception:  # noqa: BLE001 - diagnostic only
-            return {"max": 0.0, "median": 0.0}
-
-    ceiling_pre = _ceiling_safe()
-    native = run_transport("tcp")
+    # The ceiling is PAIRED: each native rep is preceded by a raw-socket
+    # window between the same two party processes (see _party_main), so
+    # lane and ceiling samples share the host regime they were measured
+    # in. On this class of shared VM, loopback throughput swings 2-3x on
+    # a seconds timescale — round 4's bracketing probes (minutes away
+    # from the stage they calibrated) produced a 77.5% ratio from regime
+    # mismatch alone; the paired median ratio is stable.
+    native = run_transport("tcp", pair_ceiling=True)
     baseline = run_transport("grpc")
-    ceiling_post = _ceiling_safe()
     dma = _try_dma_transport()
-    mids = [c["median"] for c in (ceiling_pre, ceiling_post) if c["median"]]
-    ceiling = {
-        "median": sum(mids) / len(mids) if mids else 0.0,
-        "spread": mids,
-    }
     result = {
         "metric": "2-party cross-party push throughput, 100MB float32 tensors",
         "value": round(native["max"], 3),
@@ -484,20 +694,20 @@ def main() -> None:
         "rounds": ROUNDS,
         "payload_mb": PAYLOAD_MB,
     }
-    if ceiling["median"]:
-        # Medians on both sides: peak-of-reps is inflatable by the
-        # parties' start-clock skew on short windows, the median is not.
-        result["loopback_ceiling_gbps"] = round(ceiling["median"], 3)
+    if native.get("raw_median"):
+        result["loopback_ceiling_gbps"] = round(native["raw_median"], 3)
         result["loopback_ceiling_spread"] = [
-            round(x, 3) for x in ceiling["spread"]
+            round(x, 3) for x in native["raw_spread"]
         ]
         result["pct_of_ceiling"] = round(
-            100.0 * native["median"] / ceiling["median"], 1
+            100.0 * native["paired_ratio_median"], 1
         )
     if dma:
         result["dma_cpu_gbps"] = round(dma, 3)
     if mfu:
         result.update(mfu)
+    result.update(_try_tiny_tasks())
+    result.update(_try_fedavg())
     print(json.dumps(result))
 
 

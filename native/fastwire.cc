@@ -1,11 +1,22 @@
-/* fastwire (C++): GIL-released socket IO for the FTP1 data plane.
+/* fastwire (C++): the native data-plane engine for the FTP1 wire protocol.
  *
  * The role the reference delegates to native dependencies (Ray's C++ core
- * and gRPC's C-core move its bytes; SURVEY.md C14/§2) is filled here by a
- * small CPython extension: vectored sends (writev) of header+payload in one
- * syscall batch and exact-length receives, both with the GIL released and
- * poll()-based timeouts compatible with Python socket timeout semantics
- * (Python puts timed sockets in non-blocking mode, so EAGAIN must poll).
+ * and gRPC's C-core move its bytes; SURVEY.md C14/§2, ref
+ * fed/proxy/grpc/grpc_proxy.py:23) is filled here by a CPython extension
+ * that owns the hot receive path end-to-end:
+ *
+ *   - sendv:              vectored header+payload sends (writev), GIL off
+ *   - recv_exact:         exact-length receive into a caller buffer
+ *   - recv_prefix_header: frame prefix + header in ONE GIL-released
+ *                         window, with magic/version/size-cap validation
+ *                         BEFORE any allocation
+ *   - recv_scatter:       the whole payload scatter-read into C-pooled
+ *                         buffers via readv — one GIL window, one
+ *                         syscall batch across segment boundaries
+ *   - a C-side buffer pool (PooledBuf) recycling large receive blocks
+ *     across frames, replacing the Python-side refcount-scanning pool on
+ *     the native path (fresh 100MB allocations cost page faults + munmap
+ *     per frame; the pool makes steady-state receives allocation-free)
  *
  * Plaintext sockets only — TLS connections stay on the Python ssl path.
  */
@@ -15,11 +26,19 @@
 
 #include <errno.h>
 #include <poll.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 
+#include <mutex>
+#include <vector>
+
 #define MAX_IOV 64
+
+/* ------------------------------------------------------------------ */
+/* fd polling                                                          */
+/* ------------------------------------------------------------------ */
 
 /* Wait for the fd to become ready; returns 0 ok, -1 timeout, errno>0 error. */
 static int wait_fd(int fd, short events, long timeout_ms) {
@@ -32,6 +51,201 @@ static int wait_fd(int fd, short events, long timeout_ms) {
         return errno;
     }
 }
+
+/* Receive exactly n bytes into p. Returns 0 ok, -1 timeout, -2 EOF,
+ * errno>0 error. Caller must NOT hold the GIL. */
+static int recv_all(int fd, char *p, size_t n, long timeout_ms) {
+    while (n > 0) {
+        ssize_t rc = recv(fd, p, n, 0);
+        if (rc > 0) {
+            p += rc;
+            n -= (size_t)rc;
+            continue;
+        }
+        if (rc == 0) return -2;
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            int w = wait_fd(fd, POLLIN, timeout_ms);
+            if (w == 0) continue;
+            return w;
+        }
+        return errno;
+    }
+    return 0;
+}
+
+/* Raise the Python exception matching a recv_all/err code. */
+static PyObject *raise_io(int err, const char *what) {
+    if (err == -2) {
+        PyErr_Format(PyExc_ConnectionError,
+                     "peer closed connection mid-%s", what);
+        return NULL;
+    }
+    if (err == -1) {
+        PyErr_Format(PyExc_TimeoutError, "fastwire %s timed out", what);
+        return NULL;
+    }
+    errno = err;
+    return PyErr_SetFromErrno(PyExc_OSError);
+}
+
+/* ------------------------------------------------------------------ */
+/* C-side buffer pool                                                  */
+/* ------------------------------------------------------------------ */
+
+struct Block {
+    char *p;
+    size_t size;
+};
+
+/* Free blocks, oldest first; total tracks free bytes only (a block handed
+ * to a PooledBuf is accounted by that object, not the pool). All pool
+ * state is touched with the GIL held (take/put run from Python-visible
+ * entry/exit points), so the mutex guards only against future no-GIL
+ * builds and direct C callers. */
+static std::mutex pool_mu;
+static std::vector<Block> pool_blocks;
+static size_t pool_free_bytes = 0;
+static size_t pool_cap = (size_t)2 << 30; /* overridden at module init */
+static const size_t POOL_MIN = (size_t)1 << 20;
+static const size_t POOL_ALIGN = 64;
+
+static char *block_alloc(size_t n) {
+    void *p = NULL;
+    if (posix_memalign(&p, POOL_ALIGN, n) != 0) return NULL;
+    return (char *)p;
+}
+
+/* Best-fit take: smallest free block with n <= size <= 4n (a huge block
+ * must not be burned on a small frame). Returns {NULL, n} when the pool
+ * has no candidate and the caller should allocate. */
+static Block pool_take(size_t n) {
+    Block out = {NULL, n};
+    if (n < POOL_MIN || pool_cap == 0) return out;
+    std::lock_guard<std::mutex> g(pool_mu);
+    size_t best = (size_t)-1;
+    size_t best_size = 0;
+    for (size_t i = 0; i < pool_blocks.size(); i++) {
+        size_t sz = pool_blocks[i].size;
+        if (sz >= n && sz <= (n << 2) &&
+            (best == (size_t)-1 || sz < best_size)) {
+            best = i;
+            best_size = sz;
+        }
+    }
+    if (best != (size_t)-1) {
+        out = pool_blocks[best];
+        pool_blocks.erase(pool_blocks.begin() + best);
+        pool_free_bytes -= out.size;
+    }
+    return out;
+}
+
+static void pool_put(Block b) {
+    if (b.size < POOL_MIN || pool_cap == 0) {
+        free(b.p);
+        return;
+    }
+    std::vector<Block> evicted;
+    {
+        std::lock_guard<std::mutex> g(pool_mu);
+        pool_blocks.push_back(b);
+        pool_free_bytes += b.size;
+        while (pool_free_bytes > pool_cap && pool_blocks.size() > 1) {
+            evicted.push_back(pool_blocks.front());
+            pool_free_bytes -= pool_blocks.front().size;
+            pool_blocks.erase(pool_blocks.begin());
+        }
+    }
+    for (auto &e : evicted) free(e.p);
+}
+
+/* ------------------------------------------------------------------ */
+/* PooledBuf: a writable buffer-protocol object returning its block to  */
+/* the pool on dealloc (all consumer views hold a strong reference, so  */
+/* dealloc implies no live exports).                                    */
+/* ------------------------------------------------------------------ */
+
+typedef struct {
+    PyObject_HEAD
+    char *ptr;
+    size_t alloc_size; /* underlying block size (pool key) */
+    Py_ssize_t len;    /* exposed length */
+} PooledBuf;
+
+static void PooledBuf_dealloc(PyObject *self) {
+    PooledBuf *pb = (PooledBuf *)self;
+    if (pb->ptr) {
+        Block b = {pb->ptr, pb->alloc_size};
+        pool_put(b);
+        pb->ptr = NULL;
+    }
+    Py_TYPE(self)->tp_free(self);
+}
+
+static int PooledBuf_getbuffer(PyObject *self, Py_buffer *view, int flags) {
+    PooledBuf *pb = (PooledBuf *)self;
+    if (pb->ptr == NULL) {
+        PyErr_SetString(PyExc_ValueError, "PooledBuf is released");
+        return -1;
+    }
+    return PyBuffer_FillInfo(view, self, pb->ptr, pb->len, 0, flags);
+}
+
+static PyBufferProcs PooledBuf_as_buffer = {
+    PooledBuf_getbuffer,
+    NULL,
+};
+
+static Py_ssize_t PooledBuf_length(PyObject *self) {
+    return ((PooledBuf *)self)->len;
+}
+
+static PySequenceMethods PooledBuf_as_sequence = {
+    PooledBuf_length, /* sq_length — len(buf) == payload bytes */
+};
+
+static PyTypeObject PooledBuf_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    "rayfed_tpu._fastwire.PooledBuf", /* tp_name */
+    sizeof(PooledBuf),                /* tp_basicsize */
+};
+
+/* New PooledBuf of n bytes (pool hit or fresh aligned allocation).
+ * Caller must hold the GIL. Returns NULL with an exception set. */
+static PyObject *pooledbuf_new(size_t n) {
+    Block b = pool_take(n);
+    if (b.p == NULL) {
+        b.size = n;
+        b.p = block_alloc(n ? n : 1);
+        if (b.p == NULL) return PyErr_NoMemory();
+    }
+    PooledBuf *pb = PyObject_New(PooledBuf, &PooledBuf_Type);
+    if (pb == NULL) {
+        pool_put(b);
+        return NULL;
+    }
+    pb->ptr = b.p;
+    pb->alloc_size = b.size;
+    pb->len = (Py_ssize_t)n;
+    return (PyObject *)pb;
+}
+
+/* pool_trim() -> None: drop every free block (transport stop hook). */
+static PyObject *fastwire_pool_trim(PyObject *self, PyObject *args) {
+    std::vector<Block> dropped;
+    {
+        std::lock_guard<std::mutex> g(pool_mu);
+        dropped.swap(pool_blocks);
+        pool_free_bytes = 0;
+    }
+    for (auto &b : dropped) free(b.p);
+    Py_RETURN_NONE;
+}
+
+/* ------------------------------------------------------------------ */
+/* sendv                                                               */
+/* ------------------------------------------------------------------ */
 
 /* sendv(fd, timeout_ms, buffers_sequence) -> None
  * Sends every buffer fully, in order, via writev. */
@@ -117,6 +331,10 @@ static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
     Py_RETURN_NONE;
 }
 
+/* ------------------------------------------------------------------ */
+/* recv_exact                                                          */
+/* ------------------------------------------------------------------ */
+
 /* recv_exact(fd, timeout_ms, writable_buffer) -> None
  * Fills the buffer completely or raises (ConnectionError on EOF). */
 static PyObject *fastwire_recv_exact(PyObject *self, PyObject *args) {
@@ -126,64 +344,262 @@ static PyObject *fastwire_recv_exact(PyObject *self, PyObject *args) {
     if (!PyArg_ParseTuple(args, "ilw*", &fd, &timeout_ms, &buf))
         return NULL;
 
-    int err = 0;  /* errno, -1 poll timeout, -2 EOF */
+    int err;
     Py_BEGIN_ALLOW_THREADS;
-    char *p = (char *)buf.buf;
-    size_t remaining = (size_t)buf.len;
-    while (remaining > 0) {
-        ssize_t rc = recv(fd, p, remaining, 0);
-        if (rc > 0) {
-            p += rc;
-            remaining -= (size_t)rc;
+    err = recv_all(fd, (char *)buf.buf, (size_t)buf.len, timeout_ms);
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&buf);
+    if (err != 0) return raise_io(err, "recv");
+    Py_RETURN_NONE;
+}
+
+/* ------------------------------------------------------------------ */
+/* recv_prefix_header                                                  */
+/* ------------------------------------------------------------------ */
+
+/* recv_prefix_header(fd, timeout_ms, magic4, version, max_header,
+ *                    max_payload) -> (ftype, plen, header_bytes)
+ *
+ * Reads the fixed FTP1 prefix (4s magic, u8 version, u8 ftype, u32 hlen,
+ * u64 plen, big-endian — proxy/tcp/wire.py frame layout) and the msgpack
+ * header blob in one GIL-released window. Magic, version and both size
+ * caps are validated BEFORE any allocation, so a hostile frame costs no
+ * memory (ValueError; the Python layer maps it to WireError and tears
+ * the connection down). */
+static PyObject *fastwire_recv_prefix_header(PyObject *self, PyObject *args) {
+    int fd;
+    long timeout_ms;
+    const char *magic;
+    Py_ssize_t magic_len;
+    int version;
+    unsigned long long max_header, max_payload;
+    if (!PyArg_ParseTuple(args, "ily#iKK", &fd, &timeout_ms, &magic,
+                          &magic_len, &version, &max_header, &max_payload))
+        return NULL;
+    if (magic_len != 4) {
+        PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+        return NULL;
+    }
+
+    unsigned char prefix[18]; /* 4 + 1 + 1 + 4 + 8 */
+    char *hdr = NULL;
+    int err = 0;            /* recv_all code */
+    int bad = 0;            /* 1 magic, 2 version, 3 hlen, 4 plen, 5 oom */
+    unsigned int hlen = 0;
+    unsigned long long plen = 0;
+    unsigned int ftype = 0;
+    unsigned int ver = 0;
+
+    Py_BEGIN_ALLOW_THREADS;
+    err = recv_all(fd, (char *)prefix, 18, timeout_ms);
+    if (err == 0) {
+        ver = prefix[4];
+        ftype = prefix[5];
+        hlen = ((unsigned int)prefix[6] << 24) |
+               ((unsigned int)prefix[7] << 16) |
+               ((unsigned int)prefix[8] << 8) | (unsigned int)prefix[9];
+        plen = 0;
+        for (int i = 0; i < 8; i++)
+            plen = (plen << 8) | (unsigned long long)prefix[10 + i];
+        if (memcmp(prefix, magic, 4) != 0) {
+            bad = 1;
+        } else if (ver != (unsigned int)version) {
+            bad = 2;
+        } else if ((unsigned long long)hlen > max_header) {
+            bad = 3;
+        } else if (plen > max_payload) {
+            bad = 4;
+        } else {
+            hdr = (char *)malloc(hlen ? hlen : 1);
+            if (hdr == NULL) {
+                bad = 5;
+            } else {
+                err = recv_all(fd, hdr, hlen, timeout_ms);
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS;
+
+    if (err != 0) {
+        free(hdr);
+        return raise_io(err, "recv");
+    }
+    switch (bad) {
+    case 1:
+        PyErr_Format(PyExc_ValueError, "bad magic %.4s", (char *)prefix);
+        return NULL;
+    case 2:
+        PyErr_Format(PyExc_ValueError, "unsupported wire version %u", ver);
+        return NULL;
+    case 3:
+        PyErr_Format(PyExc_ValueError, "header length %u exceeds cap", hlen);
+        return NULL;
+    case 4:
+        PyErr_Format(PyExc_ValueError,
+                     "payload length %llu exceeds cap %llu", plen,
+                     max_payload);
+        return NULL;
+    case 5:
+        return PyErr_NoMemory();
+    }
+    PyObject *hbytes = PyBytes_FromStringAndSize(hdr, (Py_ssize_t)hlen);
+    free(hdr);
+    if (hbytes == NULL) return NULL;
+    PyObject *out = Py_BuildValue("IKN", ftype, plen, hbytes);
+    return out;
+}
+
+/* ------------------------------------------------------------------ */
+/* recv_scatter                                                        */
+/* ------------------------------------------------------------------ */
+
+/* recv_scatter(fd, timeout_ms, sizes) -> [PooledBuf, ...]
+ *
+ * Allocates one pooled buffer per size and fills them all in a single
+ * GIL-released window with readv batched across segment boundaries —
+ * a segmented tree payload costs the same GIL/syscall structure as a
+ * contiguous one. Caller is responsible for size validation (the frame's
+ * plen was already capped by recv_prefix_header). */
+static PyObject *fastwire_recv_scatter(PyObject *self, PyObject *args) {
+    int fd;
+    long timeout_ms;
+    PyObject *sizes;
+    if (!PyArg_ParseTuple(args, "ilO", &fd, &timeout_ms, &sizes))
+        return NULL;
+
+    PyObject *fast = PySequence_Fast(sizes, "sizes must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    PyObject *out = PyList_New(n);
+    if (!out) {
+        Py_DECREF(fast);
+        return NULL;
+    }
+
+    std::vector<struct iovec> iov;
+    iov.reserve((size_t)n);
+    int failed = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        size_t sz = (size_t)PyLong_AsUnsignedLongLong(item);
+        if (PyErr_Occurred()) {
+            failed = 1;
+            break;
+        }
+        PyObject *pb = pooledbuf_new(sz);
+        if (pb == NULL) {
+            failed = 1;
+            break;
+        }
+        PyList_SET_ITEM(out, i, pb); /* steals */
+        struct iovec v;
+        v.iov_base = ((PooledBuf *)pb)->ptr;
+        v.iov_len = sz;
+        iov.push_back(v);
+    }
+    Py_DECREF(fast);
+    if (failed) {
+        Py_DECREF(out);
+        return NULL;
+    }
+
+    int err = 0;
+    Py_BEGIN_ALLOW_THREADS;
+    size_t first = 0;
+    while (first < iov.size()) {
+        if (iov[first].iov_len == 0) {
+            first++;
             continue;
+        }
+        int cnt = (int)(iov.size() - first);
+        if (cnt > MAX_IOV) cnt = MAX_IOV;
+        ssize_t rc = readv(fd, &iov[first], cnt);
+        if (rc < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                int w = wait_fd(fd, POLLIN, timeout_ms);
+                if (w == 0) continue;
+                err = (w == -1) ? -1 : w;
+                break;
+            }
+            err = errno;
+            break;
         }
         if (rc == 0) {
             err = -2;
             break;
         }
-        if (errno == EINTR) continue;
-        if (errno == EAGAIN || errno == EWOULDBLOCK) {
-            int w = wait_fd(fd, POLLIN, timeout_ms);
-            if (w == 0) continue;
-            err = (w == -1) ? -1 : w;
-            break;
+        size_t done = (size_t)rc;
+        while (done > 0 && first < iov.size()) {
+            if (done >= iov[first].iov_len) {
+                done -= iov[first].iov_len;
+                iov[first].iov_len = 0;
+                first++;
+            } else {
+                iov[first].iov_base = (char *)iov[first].iov_base + done;
+                iov[first].iov_len -= done;
+                done = 0;
+            }
         }
-        err = errno;
-        break;
     }
     Py_END_ALLOW_THREADS;
-    PyBuffer_Release(&buf);
 
-    if (err == -2) {
-        PyErr_SetString(PyExc_ConnectionError,
-                        "peer closed connection mid-frame");
-        return NULL;
-    }
-    if (err == -1) {
-        PyErr_SetString(PyExc_TimeoutError, "fastwire recv timed out");
-        return NULL;
-    }
     if (err != 0) {
-        errno = err;
-        return PyErr_SetFromErrno(PyExc_OSError);
+        Py_DECREF(out);
+        return raise_io(err, "recv");
     }
-    Py_RETURN_NONE;
+    return out;
 }
+
+/* ------------------------------------------------------------------ */
+/* module                                                              */
+/* ------------------------------------------------------------------ */
 
 static PyMethodDef fastwire_methods[] = {
     {"sendv", fastwire_sendv, METH_VARARGS,
      "sendv(fd, timeout_ms, buffers): fully send all buffers via writev."},
     {"recv_exact", fastwire_recv_exact, METH_VARARGS,
      "recv_exact(fd, timeout_ms, buffer): fill the writable buffer."},
+    {"recv_prefix_header", fastwire_recv_prefix_header, METH_VARARGS,
+     "recv_prefix_header(fd, timeout_ms, magic, version, max_header, "
+     "max_payload) -> (ftype, plen, header_bytes)."},
+    {"recv_scatter", fastwire_recv_scatter, METH_VARARGS,
+     "recv_scatter(fd, timeout_ms, sizes) -> list of pooled buffers."},
+    {"pool_trim", fastwire_pool_trim, METH_NOARGS,
+     "pool_trim(): free every idle pooled receive block."},
     {NULL, NULL, 0, NULL},
 };
 
 static struct PyModuleDef fastwire_module = {
     PyModuleDef_HEAD_INIT, "_fastwire",
-    "GIL-released vectored socket IO for the rayfed_tpu data plane.", -1,
+    "Native (C++) data-plane engine for the rayfed_tpu FTP1 protocol.", -1,
     fastwire_methods,
 };
 
 PyMODINIT_FUNC PyInit__fastwire(void) {
-    return PyModule_Create(&fastwire_module);
+    PooledBuf_Type.tp_dealloc = PooledBuf_dealloc;
+    PooledBuf_Type.tp_flags = Py_TPFLAGS_DEFAULT;
+    PooledBuf_Type.tp_doc = "Pooled receive buffer (writable, buffer protocol)";
+    PooledBuf_Type.tp_as_buffer = &PooledBuf_as_buffer;
+    PooledBuf_Type.tp_as_sequence = &PooledBuf_as_sequence;
+    PooledBuf_Type.tp_new = NULL; /* C-internal construction only */
+    if (PyType_Ready(&PooledBuf_Type) < 0) return NULL;
+
+    const char *cap_mb = getenv("FEDTPU_RECV_POOL_MB");
+    if (cap_mb != NULL) {
+        char *end = NULL;
+        long v = strtol(cap_mb, &end, 10);
+        if (end != cap_mb && *end == '\0' && v >= 0)
+            pool_cap = (size_t)v << 20;
+    }
+
+    PyObject *m = PyModule_Create(&fastwire_module);
+    if (m == NULL) return NULL;
+    Py_INCREF(&PooledBuf_Type);
+    if (PyModule_AddObject(m, "PooledBuf", (PyObject *)&PooledBuf_Type) < 0) {
+        Py_DECREF(&PooledBuf_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
 }

@@ -63,7 +63,11 @@ def cache_spec(
 
     batch = shd.batch_spec(mesh, party_axis, data_axis)[0]
     heads = model_axis if model_axis in mesh.axis_names else None
-    if heads is not None and n_heads is not None and             n_heads % mesh.shape[model_axis] != 0:
+    if (
+        heads is not None
+        and n_heads is not None
+        and n_heads % mesh.shape[model_axis] != 0
+    ):
         heads = None
     return P(None, batch, None, heads, None)
 
@@ -227,7 +231,7 @@ def make_generate_fn(
     cache_sharding = None
     if mesh is not None:
         cache_sharding = NamedSharding(
-            mesh, cache_spec(mesh, party_axis, data_axis)
+            mesh, cache_spec(mesh, party_axis, data_axis, n_heads=cfg.n_heads)
         )
 
     def sample(logits, key):
@@ -356,7 +360,7 @@ def make_beam_search_fn(
     cache_sharding = None
     if mesh is not None:
         cache_sharding = NamedSharding(
-            mesh, cache_spec(mesh, party_axis, data_axis)
+            mesh, cache_spec(mesh, party_axis, data_axis, n_heads=cfg.n_heads)
         )
 
     def beam_search(params, prompt):

@@ -222,7 +222,14 @@ def send(
     is_error: bool = False,
 ) -> Future:
     """Fire-and-forget push; completion future is drained asynchronously by
-    the cleanup manager (ref ``barriers.py:462-488``)."""
+    the cleanup manager (ref ``barriers.py:462-488``).
+
+    The seq-id pair ``("ping", "ping")`` is reserved for the readiness
+    barrier: a frame carrying it is consumed by the receiver's rendezvous
+    store as a liveness ping and is never delivered to ``recv``. Seq ids
+    are generated internally (monotonic integers), so user code never
+    collides with it in normal operation — but callers driving this
+    function directly must not use that pair."""
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         # Follower host of a multi-host party: the leader's identical
@@ -295,7 +302,10 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
     In a multi-host party, the leader performs the one real wire receive
     and relays the decoded value to follower hosts over the party's
     coordination service, so every host's copy of the consuming task gets
-    its arguments and the cross-host jitted computation can proceed."""
+    its arguments and the cross-host jitted computation can proceed.
+
+    The seq-id pair ``("ping", "ping")`` is reserved for the readiness
+    barrier (see ``send``); no payload ever arrives under it."""
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         relay = _party_relay_client()

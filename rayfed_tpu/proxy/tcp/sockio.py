@@ -200,6 +200,8 @@ class BufferPool:
 def trim_recv_pool() -> None:
     """Release the module pool's free blocks (called on transport stop)."""
     _RECV_POOL.trim()
+    if _fastwire is not None and hasattr(_fastwire, "pool_trim"):
+        _fastwire.pool_trim()
 
 
 def _pool_max_bytes() -> int:
@@ -215,7 +217,16 @@ def _pool_max_bytes() -> int:
         return 2 << 30
 
 
-_RECV_POOL = BufferPool(_pool_max_bytes())
+# FEDTPU_RECV_POOL_MB bounds the TOTAL receive-pool memory of the process.
+# When the native extension is loaded, its C-side pool (which reads the
+# same env var) serves every plaintext connection and owns the whole
+# budget; the Python pool stands down so the two pools cannot each retain
+# a full cap. TLS connections then receive into unpooled buffers — they
+# already pay per-byte crypto, so recycling is not their bottleneck.
+_RECV_POOL = BufferPool(
+    0 if (_fastwire is not None and hasattr(_fastwire, "recv_prefix_header"))
+    else _pool_max_bytes()
+)
 
 
 def recv_frame(
@@ -225,8 +236,15 @@ def recv_frame(
     """Blocking read of one frame. Size caps are enforced before the
     payload is buffered, so an oversized frame costs no memory — the
     connection is torn down instead of answered. Payload is a writable
-    numpy-backed view, or a :class:`serialization.SegmentedPayload` when a
-    large ``tree`` frame is scatter-read into leaf/shard-aligned buffers."""
+    buffer view, or a :class:`serialization.SegmentedPayload` when a
+    large ``tree`` frame is scatter-read into leaf/shard-aligned buffers.
+
+    On plaintext sockets with the native extension available, the whole
+    receive path (prefix+header read, validation, pooled payload buffers,
+    scatter readv) runs in C++ (two GIL-released windows per frame —
+    the role gRPC's C-core plays for the reference's data plane)."""
+    if _native_ok(sock) and hasattr(_fastwire, "recv_prefix_header"):
+        return _recv_frame_native(sock, max_payload)
     prefix = _recv_exact(sock, wire.PREFIX_LEN)
     magic, version, ftype, hlen, plen = wire._PREFIX.unpack(bytes(prefix))
     if magic != wire.WIRE_MAGIC:
@@ -271,3 +289,51 @@ def recv_frame(
     payload = _RECV_POOL.take(plen)
     _recv_exact_into(sock, memoryview(payload))
     return ftype, header, memoryview(payload)
+
+
+def _recv_frame_native(sock: socket.socket, max_payload: Optional[int]):
+    """Native (C++) receive path: one GIL window for prefix+header (with
+    validation before allocation), one for the entire payload scatter-read
+    into C-pooled buffers."""
+    cap = wire._MAX_PAYLOAD if max_payload is None else min(
+        max_payload, wire._MAX_PAYLOAD
+    )
+    timeout_ms = _timeout_ms(sock)
+    fd = sock.fileno()
+    try:
+        ftype, plen, hbytes = _fastwire.recv_prefix_header(
+            fd, timeout_ms, wire.WIRE_MAGIC, wire.WIRE_VERSION,
+            wire._MAX_HEADER, cap,
+        )
+    except TimeoutError:
+        raise socket.timeout("fastwire recv timed out") from None
+    except ValueError as e:  # protocol violation detected in C
+        raise wire.WireError(str(e)) from None
+    header = msgpack.unpackb(hbytes, raw=False)
+    if not plen:
+        return ftype, header, memoryview(b"")
+    from rayfed_tpu._private import serialization
+
+    sizes = None
+    if (
+        plen >= _SEGMENT_THRESHOLD
+        and header.get("pkind") == "tree"
+        and "comp" not in header
+    ):
+        lengths = serialization.tree_segment_lengths(
+            header.get("pmeta", b""), plen
+        )
+        if lengths is not None and len(lengths) > 1:
+            sizes = lengths
+    try:
+        bufs = _fastwire.recv_scatter(fd, timeout_ms, sizes or [plen])
+    except TimeoutError:
+        raise socket.timeout("fastwire recv timed out") from None
+    if sizes is None:
+        return ftype, header, memoryview(bufs[0])
+    segments = []
+    pos = 0
+    for n, buf in zip(sizes, bufs):
+        segments.append((pos, buf))
+        pos += n
+    return ftype, header, serialization.SegmentedPayload(segments)

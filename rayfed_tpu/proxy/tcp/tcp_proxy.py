@@ -246,18 +246,22 @@ class _DestWorker(threading.Thread):
             value = data
 
         cfg = self._cfg
+        # Build the header skeleton BEFORE _try_encode_special: once that
+        # call succeeds the alternate lane may have pinned device buffers
+        # whose leak bound depends on on_done firing, so nothing fallible
+        # may run between encode and returning on_done to the job loop.
+        header = {
+            "job": self._proxy._job_name,
+            "src": self._proxy._party,
+            "up": str(upstream_seq_id),
+            "down": str(downstream_seq_id),
+            "is_error": bool(is_error),
+        }
         special = self._proxy._try_encode_special(value, is_error, cfg)
         if special is not None:
             kind, payload, on_done = special
-            header = {
-                "job": self._proxy._job_name,
-                "src": self._proxy._party,
-                "up": str(upstream_seq_id),
-                "down": str(downstream_seq_id),
-                "is_error": bool(is_error),
-                "pkind": kind,
-                "pmeta": b"",
-            }
+            header["pkind"] = kind
+            header["pmeta"] = b""
             return header, [payload], len(payload), on_done
 
         kind, meta, buffers = serialization.encode_payload(value)
@@ -273,15 +277,8 @@ class _DestWorker(threading.Thread):
                 f"payload of {payload_len} bytes exceeds the effective "
                 f"messages_max_size_in_bytes={max_bytes}"
             )
-        header = {
-            "job": self._proxy._job_name,
-            "src": self._proxy._party,
-            "up": str(upstream_seq_id),
-            "down": str(downstream_seq_id),
-            "is_error": bool(is_error),
-            "pkind": kind,
-            "pmeta": meta,
-        }
+        header["pkind"] = kind
+        header["pmeta"] = meta
         if cfg.payload_compression and payload_len:
             packed = serialization.compress_buffers(
                 buffers, cfg.payload_compression, cfg.compression_level
