@@ -1,0 +1,217 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Unit tests for the native (C++) receive engine in ``_fastwire``.
+
+The integration suite exercises this path through every plaintext
+transport test; here the C API surface is pinned directly: validation
+before allocation, pooled-buffer lifetime, scatter reads across many
+segments, and EOF/garbage handling. (Role parity: the reference's data
+plane rides gRPC C-core, ref ``fed/proxy/grpc/grpc_proxy.py:23``.)
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from rayfed_tpu.proxy.tcp import wire
+
+_fastwire = pytest.importorskip("rayfed_tpu._fastwire")
+
+pytestmark = pytest.mark.skipif(
+    not hasattr(_fastwire, "recv_prefix_header"),
+    reason="native receive engine not built",
+)
+
+_PREFIX = struct.Struct(">4sBBIQ")
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def _frame(hdr: bytes, payload: bytes, ftype=0) -> bytes:
+    return _PREFIX.pack(wire.WIRE_MAGIC, wire.WIRE_VERSION, ftype,
+                        len(hdr), len(payload)) + hdr + payload
+
+
+def _recv_ph(sock, max_header=1 << 20, max_payload=1 << 30):
+    return _fastwire.recv_prefix_header(
+        sock.fileno(), 5000, wire.WIRE_MAGIC, wire.WIRE_VERSION,
+        max_header, max_payload,
+    )
+
+
+def test_prefix_header_roundtrip():
+    a, b = _pair()
+    with a, b:
+        a.sendall(_frame(b"\x81\xa1k\xa1v", b"xyz", ftype=1))
+        ftype, plen, hbytes = _recv_ph(b)
+        assert (ftype, plen, hbytes) == (1, 3, b"\x81\xa1k\xa1v")
+        (buf,) = _fastwire.recv_scatter(b.fileno(), 5000, [3])
+        assert bytes(memoryview(buf)) == b"xyz"
+
+
+def test_bad_magic_rejected_before_any_read_of_body():
+    a, b = _pair()
+    with a, b:
+        a.sendall(b"EVIL" + bytes(14))
+        with pytest.raises(ValueError, match="bad magic"):
+            _recv_ph(b)
+
+
+def test_wrong_version_rejected():
+    a, b = _pair()
+    with a, b:
+        raw = _PREFIX.pack(wire.WIRE_MAGIC, wire.WIRE_VERSION + 1, 0, 0, 0)
+        a.sendall(raw)
+        with pytest.raises(ValueError, match="version"):
+            _recv_ph(b)
+
+
+def test_hostile_header_length_rejected_before_allocation():
+    a, b = _pair()
+    with a, b:
+        raw = _PREFIX.pack(wire.WIRE_MAGIC, wire.WIRE_VERSION, 0,
+                           0x7FFFFFFF, 0)
+        a.sendall(raw)
+        with pytest.raises(ValueError, match="header length"):
+            _recv_ph(b, max_header=1 << 20)
+
+
+def test_hostile_payload_length_rejected_before_allocation():
+    a, b = _pair()
+    with a, b:
+        raw = _PREFIX.pack(wire.WIRE_MAGIC, wire.WIRE_VERSION, 0, 0,
+                           1 << 50)
+        a.sendall(raw)
+        with pytest.raises(ValueError, match="payload length"):
+            _recv_ph(b, max_payload=1 << 30)
+
+
+def test_eof_mid_prefix_and_mid_header():
+    a, b = _pair()
+    with b:
+        a.sendall(b"FTP")  # partial magic
+        a.close()
+        with pytest.raises(ConnectionError):
+            _recv_ph(b)
+    a, b = _pair()
+    with b:
+        raw = _PREFIX.pack(wire.WIRE_MAGIC, wire.WIRE_VERSION, 0, 10, 0)
+        a.sendall(raw + b"half")  # 4 of 10 header bytes
+        a.close()
+        with pytest.raises(ConnectionError):
+            _recv_ph(b)
+
+
+def test_timeout_raises_timeout_error():
+    a, b = _pair()
+    with a, b:
+        # The poll-based timeout engages on non-blocking fds — the same
+        # mode Python's settimeout() uses, and the only mode the lane
+        # passes a finite timeout_ms for. On a blocking fd the C recv
+        # blocks in the kernel (timeout_ms < 0 semantics).
+        b.setblocking(False)
+        with pytest.raises(TimeoutError):
+            _fastwire.recv_prefix_header(
+                b.fileno(), 50, wire.WIRE_MAGIC, wire.WIRE_VERSION,
+                1 << 20, 1 << 30,
+            )
+
+
+def test_scatter_many_segments_exact_bytes():
+    # More segments than one readv batch (64 iovecs) to cover batching.
+    sizes = [3, 1, 7, 64, 129] + [5] * 100
+    blob = b"".join(bytes([i % 251]) * n for i, n in enumerate(sizes))
+    a, b = _pair()
+    with a, b:
+        t = threading.Thread(target=a.sendall, args=(blob,))
+        t.start()
+        bufs = _fastwire.recv_scatter(b.fileno(), 5000, sizes)
+        t.join()
+    assert [len(x) for x in bufs] == sizes
+    got = b"".join(bytes(memoryview(x)) for x in bufs)
+    assert got == blob
+
+
+def test_scatter_eof_mid_payload():
+    a, b = _pair()
+    with b:
+        a.sendall(b"123")
+        a.close()
+        with pytest.raises(ConnectionError):
+            _fastwire.recv_scatter(b.fileno(), 5000, [10])
+
+
+def test_pooled_buffer_recycled_after_views_die():
+    # Two sequential >=1MB receives reuse the same pooled block once the
+    # first buffer and every view of it are dead.
+    n = 1 << 20
+    payload = bytes(n)
+
+    def _one_recv():
+        a, b = _pair()
+        with a, b:
+            t = threading.Thread(target=a.sendall, args=(payload,))
+            t.start()
+            (buf,) = _fastwire.recv_scatter(b.fileno(), 5000, [n])
+            t.join()
+            view = memoryview(buf)
+            addr = _buffer_addr(view)
+            view.release()
+            return addr, buf
+
+    addr1, buf1 = _one_recv()
+    del buf1  # block returns to the C pool
+    addr2, buf2 = _one_recv()
+    assert addr1 == addr2, "pool did not recycle the freed block"
+    del buf2
+    _fastwire.pool_trim()
+    addr3, buf3 = _one_recv()  # after trim a fresh block is allocated
+    del buf3
+    assert isinstance(addr3, int)
+
+
+def _buffer_addr(view: memoryview) -> int:
+    import ctypes
+
+    c = (ctypes.c_char * view.nbytes).from_buffer(view)
+    try:
+        return ctypes.addressof(c)
+    finally:
+        del c
+
+
+def test_pooled_buffer_is_writable_and_sized():
+    a, b = _pair()
+    with a, b:
+        a.sendall(b"abcd")
+        (buf,) = _fastwire.recv_scatter(b.fileno(), 5000, [4])
+    assert len(buf) == 4
+    view = memoryview(buf)
+    assert not view.readonly
+    view[0] = ord("z")
+    assert bytes(view) == b"zbcd"
+
+
+def test_zero_length_scatter_entry():
+    a, b = _pair()
+    with a, b:
+        a.sendall(b"ab")
+        bufs = _fastwire.recv_scatter(b.fileno(), 5000, [1, 0, 1])
+        assert [bytes(memoryview(x)) for x in bufs] == [b"a", b"", b"b"]
