@@ -217,12 +217,17 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
                     pass
                 raw_sock = None
                 raw_samples = []  # partial pairing would skew the ratio
+        if pair_ceiling:
             # Barrier before the lane window: alice's _raw_send returns
             # with up to ~2x SO_SNDBUF still unread in kernel buffers;
             # starting the lane push then would overlap bob's raw-timer
             # tail with lane work, deflating the ceiling sample in the
             # lane's favor. A bob-owned no-op resolves only after bob's
-            # program has finished its raw window.
+            # program has finished its raw window. Runs UNCONDITIONALLY
+            # under pair_ceiling (cheap no-op when the rig is down):
+            # gating it on the per-process raw_sock would deadlock both
+            # parties the moment a rig failure is asymmetric — one side
+            # waiting at this barrier for a peer that skipped it.
             fed.get(tell_port.party("bob").remote(rep))
 
         t0 = time.perf_counter()
@@ -470,12 +475,15 @@ def _fedavg_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
-def _run_two_party(target, transport, extra_args, timeout_s=300) -> dict:
-    """Generic 2-party spawn harness: run ``target(party, addresses,
-    transport, result_path, *extra_args)`` in two processes; return the
+def _run_two_party(target, transport, extra_args, timeout_s=300,
+                   parties=("alice", "bob")) -> dict:
+    """Generic N-party spawn harness: run ``target(party, addresses,
+    transport, result_path, *extra_args)`` once per party; return the
     result dict the writer party left at result_path."""
-    p1, p2 = _free_ports(2)
-    addresses = {"alice": f"127.0.0.1:{p1}", "bob": f"127.0.0.1:{p2}"}
+    ports = _free_ports(len(parties))
+    addresses = {
+        party: f"127.0.0.1:{port}" for party, port in zip(parties, ports)
+    }
     mp = multiprocessing.get_context("spawn")
     with tempfile.TemporaryDirectory() as tmp:
         result_path = os.path.join(tmp, "result.json")
@@ -484,7 +492,7 @@ def _run_two_party(target, transport, extra_args, timeout_s=300) -> dict:
                 target=target,
                 args=(party, addresses, transport, result_path) + extra_args,
             )
-            for party in ("alice", "bob")
+            for party in parties
         ]
         for p in procs:
             p.start()
@@ -503,41 +511,141 @@ def _run_two_party(target, transport, extra_args, timeout_s=300) -> dict:
             return json.load(f)
 
 
-def _try_tiny_tasks():
-    """Per-task overhead (BASELINE config #1) on the native lane and the
-    reference-parity gRPC lane; keys land in the driver's JSON so
-    round-over-round regressions are visible (VERDICT r4 #3)."""
+def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
+                 cpu_force=False, parties=("alice", "bob"), timeout_s=300,
+                 digits=2) -> dict:
+    """Run one two-to-N-party workload per (transport, result-key) pair.
+
+    ``cpu_force`` wraps the spawned parties in :func:`_cpu_forced` —
+    required whenever the workload jits (two processes cannot share the
+    driver's single chip; a wedged accelerator tunnel must not hang the
+    children). Best-effort: on failure the keys gathered so far are kept
+    and the rest are skipped with a stderr note — the headline JSON line
+    always prints."""
     out = {}
     try:
-        rounds = int(os.environ.get("FEDTPU_BENCH_TINY_ROUNDS", 300))
-        res = _run_two_party(_tiny_party, "tcp", (rounds,))
-        out["tiny_task_overhead_ms"] = round(res["per_task_ms"], 3)
-        res = _run_two_party(_tiny_party, "grpc", (rounds,))
-        out["tiny_task_overhead_grpc_ms"] = round(res["per_task_ms"], 3)
+        with _cpu_forced() if cpu_force else contextlib.nullcontext():
+            rounds = int(os.environ.get(env_var, default_rounds))
+            for transport, key in keys:
+                res = _run_two_party(
+                    party_fn, transport, (rounds,),
+                    timeout_s=timeout_s, parties=parties,
+                )
+                out[key] = round(res[res_field], digits)
     except Exception as e:  # noqa: BLE001 - bench must still print its line
-        print(f"tiny-task bench skipped: {e!r}", file=sys.stderr)
+        print(f"{party_fn.__name__} bench skipped: {e!r}", file=sys.stderr)
     return out
 
 
-def _try_fedavg():
-    """2-party FedAvg logistic-regression round latency (BASELINE config
-    #3) on the native and gRPC-parity lanes (VERDICT r4 #3).
+_HIER4 = ("alice", "bob", "carol", "dave")
 
-    Parties are forced onto the CPU jax backend (the aggregation helpers
-    are jitted): two processes cannot share the driver's single chip, and
-    a wedged accelerator tunnel must not hang the spawned children —
-    round latency here measures orchestration + transport."""
-    out = {}
-    try:
-        with _cpu_forced():
-            rounds = int(os.environ.get("FEDTPU_BENCH_FEDAVG_ROUNDS", 20))
-            res = _run_two_party(_fedavg_party, "tcp", (rounds,))
-            out["fedavg_round_ms"] = round(res["round_ms"], 2)
-            res = _run_two_party(_fedavg_party, "grpc", (rounds,))
-            out["fedavg_round_grpc_ms"] = round(res["round_ms"], 2)
-    except Exception as e:  # noqa: BLE001 - bench must still print its line
-        print(f"fedavg bench skipped: {e!r}", file=sys.stderr)
-    return out
+
+def _hier4_party(party, addresses, transport, result_path, rounds):
+    """4-party hierarchical aggregation tree (BASELINE config #4): each
+    party contributes a 4MB gradient tree per round; ``fed_aggregate``
+    reduces pairwise (2 rounds of 2-way reduces), so the coordinator's
+    fan-in is halved versus an all-to-root star."""
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.federated import fed_aggregate
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        job_name=f"bench-hier4-{transport}",
+        logging_level="error",
+    )
+    n_elem = 1 << 20  # 4MB float32 per party per round
+
+    @fed.remote
+    def contrib(seed):
+        return {"g": np.full((n_elem,), float(seed), np.float32)}
+
+    def one_round(r):
+        objs = {
+            p: contrib.party(p).remote(float(r * 10 + i))
+            for i, p in enumerate(_HIER4)
+        }
+        agg = fed_aggregate(objs, op="mean")
+        out = fed.get(agg)
+        expect = sum(r * 10 + i for i in range(4)) / 4.0
+        assert float(np.asarray(out["g"])[0]) == expect
+        return out
+
+    one_round(-1)  # warmup (connections, executor)
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        one_round(r)
+    dt = time.perf_counter() - t0
+    if party == "alice":
+        with open(result_path, "w") as f:
+            json.dump({"round_ms": dt / rounds * 1000}, f)
+    fed.shutdown()
+
+
+def _cnn_party(party, addresses, transport, result_path, rounds):
+    """2-party federated CNN round at CIFAR-10 shapes (BASELINE config
+    #5): per-party data shards, local jitted train steps, FedAvg of the
+    full parameter tree each round."""
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.federated import FedAvgTrainer
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        job_name=f"bench-cnn-{transport}",
+        logging_level="error",
+    )
+
+    @fed.remote
+    class CnnWorker:
+        def __init__(self, seed):
+            import jax
+
+            from rayfed_tpu.models.cnn import cnn_loss, init_cnn
+
+            self.params = init_cnn(jax.random.PRNGKey(0))
+            rng = np.random.default_rng(seed)
+            self.x = rng.normal(size=(32, 32, 32, 3)).astype(np.float32)
+            self.y = rng.integers(0, 10, size=(32,))
+
+            def step(params, x, y):
+                loss, grads = jax.value_and_grad(cnn_loss)(params, x, y)
+                return jax.tree_util.tree_map(
+                    lambda p, g: p - 0.05 * g, params, grads
+                ), loss
+
+            self._step = jax.jit(step)
+
+        def train(self, global_params):
+            if global_params is not None:
+                self.params = global_params
+            for _ in range(2):  # local steps
+                self.params, _ = self._step(self.params, self.x, self.y)
+            return self.params
+
+    trainer = FedAvgTrainer(
+        CnnWorker, ["alice", "bob"],
+        worker_args={"alice": (1,), "bob": (2,)},
+    )
+    # Warmup round absorbs actor init + the jit compile.
+    global_params = fed.get(trainer.run(1))
+    t0 = time.perf_counter()
+    final = fed.get(trainer.run(rounds, global_params))
+    dt = time.perf_counter() - t0
+    assert all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in (final["head"]["w"], final["dense"]["w"])
+    )
+    if party == "alice":
+        with open(result_path, "w") as f:
+            json.dump({"round_ms": dt / rounds * 1000}, f)
+    fed.shutdown()
 
 
 def _try_build_fastwire() -> None:
@@ -724,8 +832,27 @@ def main() -> None:
     result.update(tpu_lanes)
     if mfu:
         result.update(mfu)
-    result.update(_try_tiny_tasks())
-    result.update(_try_fedavg())
+    # BASELINE.json configs #1/#3/#4/#5 as driver keys; #1 and #3 also
+    # measured on the reference-parity gRPC lane for the ratio.
+    result.update(_bench_stage(
+        _tiny_party, "per_task_ms", "FEDTPU_BENCH_TINY_ROUNDS", 300,
+        [("tcp", "tiny_task_overhead_ms"),
+         ("grpc", "tiny_task_overhead_grpc_ms")],
+        digits=3,
+    ))
+    result.update(_bench_stage(
+        _fedavg_party, "round_ms", "FEDTPU_BENCH_FEDAVG_ROUNDS", 20,
+        [("tcp", "fedavg_round_ms"), ("grpc", "fedavg_round_grpc_ms")],
+        cpu_force=True,
+    ))
+    result.update(_bench_stage(
+        _hier4_party, "round_ms", "FEDTPU_BENCH_HIER4_ROUNDS", 20,
+        [("tcp", "hier4_round_ms")], cpu_force=True, parties=_HIER4,
+    ))
+    result.update(_bench_stage(
+        _cnn_party, "round_ms", "FEDTPU_BENCH_CNN_ROUNDS", 5,
+        [("tcp", "fedavg_cnn_round_ms")], cpu_force=True, timeout_s=420,
+    ))
     print(json.dumps(result))
 
 

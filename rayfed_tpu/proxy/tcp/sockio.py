@@ -107,6 +107,32 @@ def _recv_exact(sock: socket.socket, n: int) -> bytearray:
 _SEGMENT_THRESHOLD = 1 << 20
 
 
+def _effective_cap(max_payload: Optional[int]) -> int:
+    return wire._MAX_PAYLOAD if max_payload is None else min(
+        max_payload, wire._MAX_PAYLOAD
+    )
+
+
+def _segment_sizes(header: Dict, plen: int):
+    """Per-segment byte lengths for a scatter-read, or None when the
+    frame is received into one contiguous buffer. Shared by the Python
+    and native receive paths — the segmentation rule must never diverge
+    between them (TLS rides the Python path, plaintext the native)."""
+    if (
+        plen >= _SEGMENT_THRESHOLD
+        and header.get("pkind") == "tree"
+        and "comp" not in header
+    ):
+        from rayfed_tpu._private import serialization
+
+        lengths = serialization.tree_segment_lengths(
+            header.get("pmeta", b""), plen
+        )
+        if lengths is not None and len(lengths) > 1:
+            return lengths
+    return None
+
+
 class BufferPool:
     """Recycles large receive buffers across frames.
 
@@ -253,9 +279,7 @@ def recv_frame(
         raise wire.WireError(f"unsupported wire version {version}")
     if hlen > wire._MAX_HEADER:
         raise wire.WireError(f"header length {hlen} exceeds cap")
-    cap = wire._MAX_PAYLOAD if max_payload is None else min(
-        max_payload, wire._MAX_PAYLOAD
-    )
+    cap = _effective_cap(max_payload)
     if plen > cap:
         raise wire.WireError(f"payload length {plen} exceeds cap {cap}")
     header = msgpack.unpackb(bytes(_recv_exact(sock, hlen)), raw=False)
@@ -266,25 +290,16 @@ def recv_frame(
     # overwrites every byte); the returned view stays writable.
     from rayfed_tpu._private import serialization
 
-    # Compressed frames are one opaque blob; scatter-reading by the
-    # (uncompressed) tree extents only applies to raw tree payloads.
-    if (
-        plen >= _SEGMENT_THRESHOLD
-        and header.get("pkind") == "tree"
-        and "comp" not in header
-    ):
-        lengths = serialization.tree_segment_lengths(
-            header.get("pmeta", b""), plen
-        )
-        if lengths is not None and len(lengths) > 1:
-            segments = []
-            pos = 0
-            for n in lengths:
-                buf = _RECV_POOL.take(n)
-                _recv_exact_into(sock, memoryview(buf))
-                segments.append((pos, buf))
-                pos += n
-            return ftype, header, serialization.SegmentedPayload(segments)
+    sizes = _segment_sizes(header, plen)
+    if sizes is not None:
+        segments = []
+        pos = 0
+        for n in sizes:
+            buf = _RECV_POOL.take(n)
+            _recv_exact_into(sock, memoryview(buf))
+            segments.append((pos, buf))
+            pos += n
+        return ftype, header, serialization.SegmentedPayload(segments)
 
     payload = _RECV_POOL.take(plen)
     _recv_exact_into(sock, memoryview(payload))
@@ -295,15 +310,12 @@ def _recv_frame_native(sock: socket.socket, max_payload: Optional[int]):
     """Native (C++) receive path: one GIL window for prefix+header (with
     validation before allocation), one for the entire payload scatter-read
     into C-pooled buffers."""
-    cap = wire._MAX_PAYLOAD if max_payload is None else min(
-        max_payload, wire._MAX_PAYLOAD
-    )
     timeout_ms = _timeout_ms(sock)
     fd = sock.fileno()
     try:
         ftype, plen, hbytes = _fastwire.recv_prefix_header(
             fd, timeout_ms, wire.WIRE_MAGIC, wire.WIRE_VERSION,
-            wire._MAX_HEADER, cap,
+            wire._MAX_HEADER, _effective_cap(max_payload),
         )
     except TimeoutError:
         raise socket.timeout("fastwire recv timed out") from None
@@ -314,17 +326,7 @@ def _recv_frame_native(sock: socket.socket, max_payload: Optional[int]):
         return ftype, header, memoryview(b"")
     from rayfed_tpu._private import serialization
 
-    sizes = None
-    if (
-        plen >= _SEGMENT_THRESHOLD
-        and header.get("pkind") == "tree"
-        and "comp" not in header
-    ):
-        lengths = serialization.tree_segment_lengths(
-            header.get("pmeta", b""), plen
-        )
-        if lengths is not None and len(lengths) > 1:
-            sizes = lengths
+    sizes = _segment_sizes(header, plen)
     try:
         bufs = _fastwire.recv_scatter(fd, timeout_ms, sizes or [plen])
     except TimeoutError:
