@@ -199,14 +199,22 @@ def tree_segment_lengths(meta_bytes: bytes, plen: int):
 
 def _array_buffer(arr: np.ndarray):
     """A bytes-like for the raw contents of a C-contiguous array. Zero-copy
-    (memoryview) when the buffer protocol supports the dtype; falls back to
-    a copy for exotic dtypes (bfloat16, float8) and 0-d/empty arrays."""
+    (memoryview) when the buffer protocol supports the dtype; ml_dtypes
+    dtypes (bfloat16, float8) are reinterpreted as a same-width integer
+    view (the buffer protocol rejects them directly, and ``tobytes`` would
+    copy); only 0-d/empty arrays fall back to a copy."""
     if arr.nbytes == 0:
         return b""
     try:
         return memoryview(arr).cast("B")
     except (ValueError, TypeError):
-        return arr.tobytes()
+        pass
+    if arr.ndim and arr.dtype.itemsize in (1, 2, 4):
+        view = arr.view({1: np.uint8, 2: np.uint16, 4: np.uint32}[
+            arr.dtype.itemsize
+        ])
+        return memoryview(view).cast("B")
+    return arr.tobytes()
 
 
 def buffer_nbytes(buf) -> int:
@@ -333,12 +341,48 @@ def _spec_from_wire(w: dict) -> tree_util.TreeSpec:
     )
 
 
-def try_encode_tree(data: Any) -> Optional[Tuple[dict, List[Any]]]:
+# Lossy wire precision (config ``payload_wire_dtype``): accepted knob
+# values -> canonical numpy dtype names. bf16 keeps float32's exponent
+# range (safe for gradients); fp16 halves mantissa error but overflows
+# past 65504 — callers pick their poison explicitly.
+WIRE_DTYPES = {
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+    "fp16": "float16",
+    "float16": "float16",
+}
+
+
+def wire_dtype_name(knob: Optional[str]) -> Optional[str]:
+    """Canonical dtype name for the ``payload_wire_dtype`` knob (None
+    passes through); unknown values raise at send time, like the
+    compression knobs."""
+    if knob is None:
+        return None
+    try:
+        return WIRE_DTYPES[str(knob).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown payload_wire_dtype {knob!r}; supported: "
+            f"{sorted(set(WIRE_DTYPES))}"
+        ) from None
+
+
+def try_encode_tree(
+    data: Any, wire_dtype: Optional[str] = None
+) -> Optional[Tuple[dict, List[Any]]]:
     """Attempt the zero-pickle encoding.
 
     Returns (meta, buffers) or None if the payload needs pickling. ``meta``
     is msgpack-encodable; ``buffers`` is a list of byte-like objects to be
     written after the header (no concatenation of large arrays).
+
+    ``wire_dtype`` (canonical name from :func:`wire_dtype_name`) downcasts
+    wide-float dense array leaves on the wire — LOSSY, opt-in; each leaf's
+    original dtype rides the meta (``odt``) and is restored on decode.
+    Sharded (``sharr``) leaves ship at native dtype (their buffers are
+    zero-copy device-shard views; a downcast would force a host copy of
+    every shard).
     """
     leaves, spec = tree_util.tree_flatten(data)
     wire_spec = _spec_to_wire(spec)
@@ -359,18 +403,32 @@ def try_encode_tree(data: Any) -> Optional[Tuple[dict, List[Any]]]:
             arr = np.asarray(leaf)  # device->host for jax arrays
             if arr.dtype == object:
                 return None
+            if not arr.dtype.isnative:
+                # The wire declares endianness-less dtype NAMES and the
+                # receiver reads native order — a big-endian source array
+                # shipped raw would decode to garbage values.
+                arr = arr.astype(arr.dtype.newbyteorder("="))
+            odt = None
+            if (
+                wire_dtype is not None
+                and arr.dtype.kind == "f"
+                and arr.dtype.itemsize > 2
+            ):
+                odt = arr.dtype.name
+                arr = arr.astype(_np_dtype(wire_dtype))
             if not arr.flags["C_CONTIGUOUS"]:
                 arr = np.ascontiguousarray(arr)
             buf = _array_buffer(arr)
-            descs.append(
-                {
-                    "t": "arr",
-                    "dtype": arr.dtype.name,
-                    "shape": list(arr.shape),
-                    "off": offset,
-                    "n": arr.nbytes,
-                }
-            )
+            desc = {
+                "t": "arr",
+                "dtype": arr.dtype.name,
+                "shape": list(arr.shape),
+                "off": offset,
+                "n": arr.nbytes,
+            }
+            if odt is not None:
+                desc["odt"] = odt
+            descs.append(desc)
             buffers.append(buf)
             offset += arr.nbytes
         elif isinstance(leaf, _MSGPACK_SCALARS):
@@ -486,6 +544,12 @@ def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:
             dtype = _np_dtype(d["dtype"])
             raw = payload_range(payload, d["off"], d["n"])
             arr = np.frombuffer(raw, dtype=dtype).reshape(d["shape"])
+            odt = d.get("odt")
+            if odt:
+                # Lossy-wire leaf: restore the producer's dtype so the
+                # consumer sees the type it sent (values carry the
+                # wire dtype's rounding).
+                arr = arr.astype(_np_dtype(odt))
             leaves.append(arr)
         elif d["t"] == "sharr":
             if sharded_fn is not None:
@@ -497,14 +561,18 @@ def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:
     return tree_util.tree_unflatten(leaves, spec)
 
 
-def encode_payload(data: Any) -> Tuple[str, bytes, List[Any]]:
+def encode_payload(
+    data: Any, wire_dtype: Optional[str] = None
+) -> Tuple[str, bytes, List[Any]]:
     """Encode any payload for the wire.
 
     Returns (kind, meta_bytes, buffers): kind in {"tree", "pickle"};
     meta_bytes is msgpack (tree) or empty (pickle); buffers are written
-    after the frame header in order.
+    after the frame header in order. ``wire_dtype`` — see
+    :func:`try_encode_tree` (tree lane only; the pickle lane ships
+    objects verbatim).
     """
-    enc = try_encode_tree(data)
+    enc = try_encode_tree(data, wire_dtype=wire_dtype)
     if enc is not None:
         meta, buffers = enc
         return "tree", msgpack.packb(meta, use_bin_type=True), buffers

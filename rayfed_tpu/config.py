@@ -151,6 +151,17 @@ class CrossSiloMessageConfig:
     # lane ignores it (the reference wire has no such field).
     payload_compression: Optional[str] = None
     compression_level: int = 1
+    # LOSSY wire precision on the native TCP/TPU lanes (None = off):
+    # "bf16" or "fp16" ships wide-float dense array leaves downcast,
+    # halving bytes for fp32 gradient pushes — the standard federated
+    # gradient-compression trade (bf16 keeps fp32's exponent range and
+    # is the safe choice for gradients; fp16 overflows past 65504).
+    # The receiver restores the original dtype, values carry the wire
+    # rounding (~2^-8 relative for bf16). Sharded-array leaves, the gRPC
+    # parity lane, and the device-DMA lane (device-resident pulls never
+    # pass through the host codec) are unaffected — all-jax-Array
+    # payloads under ``device_dma: true`` ship native precision.
+    payload_wire_dtype: Optional[str] = None
     # Device-DMA data plane on the TPU transport (opt-in): all-jax-Array
     # payloads are pulled device-to-device through a per-party
     # jax.experimental.transfer server; the ordinary socket frame carries

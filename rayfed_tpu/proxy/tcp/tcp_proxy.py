@@ -264,7 +264,12 @@ class _DestWorker(threading.Thread):
             header["pmeta"] = b""
             return header, [payload], len(payload), on_done
 
-        kind, meta, buffers = serialization.encode_payload(value)
+        kind, meta, buffers = serialization.encode_payload(
+            value,
+            wire_dtype=serialization.wire_dtype_name(
+                getattr(cfg, "payload_wire_dtype", None)
+            ),
+        )
         if kind == "pickle" and not cfg.allow_pickle_payloads and not is_error:
             raise ValueError(
                 "payload requires pickling but allow_pickle_payloads=False "

@@ -1,0 +1,158 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Lossy wire precision (``payload_wire_dtype``): fp32/fp64 dense leaves
+ship as bf16/fp16 and are restored to their original dtype on arrival —
+the standard federated gradient-compression trade. No reference analog
+(the reference wire is cloudpickle-everything, ref
+``fed/proxy/grpc/grpc_proxy.py:193-220``)."""
+
+import numpy as np
+import pytest
+
+import rayfed_tpu as fed
+from rayfed_tpu._private import serialization as ser
+from tests.utils import FAST_COMM_CONFIG, run_parties
+
+
+def _roundtrip(value, wire_dtype=None):
+    kind, meta, buffers = ser.encode_payload(
+        value, wire_dtype=ser.wire_dtype_name(wire_dtype)
+    )
+    assert kind == "tree", kind
+    payload = b"".join(bytes(memoryview(b).cast("B")) for b in buffers)
+    return ser.decode_payload(kind, meta, payload, {})
+
+
+def test_bf16_representable_values_roundtrip_exactly():
+    # Powers of two and small integers are exact in bf16.
+    x = np.array([1.0, -2.0, 0.5, 4096.0, 0.0, -0.25], np.float32)
+    out = _roundtrip({"g": x}, "bf16")
+    assert out["g"].dtype == np.float32
+    np.testing.assert_array_equal(out["g"], x)
+
+
+def test_bf16_error_bound_and_dtype_restoration():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512,)).astype(np.float32)
+    out = _roundtrip({"g": x}, "bf16")["g"]
+    assert out.dtype == np.float32
+    # bf16 has an 8-bit mantissa: relative error <= 2^-8.
+    np.testing.assert_allclose(out, x, rtol=2**-8, atol=0)
+    assert not np.array_equal(out, x)  # genuinely lossy on random data
+
+
+def test_fp16_roundtrip_and_float64_downcast():
+    x64 = np.linspace(-1.0, 1.0, 64, dtype=np.float64)
+    out = _roundtrip({"g": x64}, "fp16")["g"]
+    assert out.dtype == np.float64
+    np.testing.assert_allclose(out, x64, rtol=2**-11, atol=2**-20)
+
+
+def test_bf16_keeps_fp32_range_where_fp16_overflows():
+    x = np.array([1e5, -3e38], np.float32)
+    out = _roundtrip({"g": x}, "bf16")["g"]
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, x, rtol=2**-8)
+
+
+def test_non_float_and_half_leaves_untouched():
+    tree = {
+        "i": np.arange(16, dtype=np.int32),
+        "b": np.array([True, False]),
+        "h": np.array([1.5, 2.5], np.float16),  # already narrow
+        "s": "label",
+        "k": 7,
+    }
+    out = _roundtrip(tree, "bf16")
+    np.testing.assert_array_equal(out["i"], tree["i"])
+    np.testing.assert_array_equal(out["b"], tree["b"])
+    assert out["h"].dtype == np.float16
+    np.testing.assert_array_equal(out["h"], tree["h"])
+    assert out["s"] == "label" and out["k"] == 7
+
+
+def test_wire_bytes_actually_halve():
+    x = np.zeros(1024, np.float32)
+    _, _, raw = ser.encode_payload({"g": x})
+    _, _, cast = ser.encode_payload(
+        {"g": x}, wire_dtype=ser.wire_dtype_name("bf16")
+    )
+    assert sum(memoryview(b).nbytes for b in cast) * 2 == sum(
+        memoryview(b).nbytes for b in raw
+    )
+
+
+def test_unknown_knob_rejected():
+    with pytest.raises(ValueError, match="payload_wire_dtype"):
+        ser.wire_dtype_name("int4")
+
+
+def test_off_by_default_bitwise_exact():
+    x = np.random.default_rng(1).normal(size=(64,)).astype(np.float32)
+    out = _roundtrip({"g": x})
+    assert out["g"].dtype == np.float32
+    np.testing.assert_array_equal(out["g"], x)
+
+
+def run_bf16_push(party, addresses):
+    comm = dict(FAST_COMM_CONFIG)
+    comm["payload_wire_dtype"] = "bf16"
+    fed.init(
+        addresses=addresses, party=party,
+        config={"cross_silo_comm": comm, "transport": "tcp"},
+    )
+
+    @fed.remote
+    def grads(seed):
+        return np.random.default_rng(seed).normal(size=(2048,)).astype(
+            np.float32
+        )
+
+    @fed.remote
+    def check(g):
+        expect = np.random.default_rng(7).normal(size=(2048,)).astype(
+            np.float32
+        )
+        assert g.dtype == np.float32
+        np.testing.assert_allclose(g, expect, rtol=2**-8, atol=0)
+        return float(np.abs(g).sum())
+
+    got = fed.get(check.party("bob").remote(grads.party("alice").remote(7)))
+    assert np.isfinite(got) and got > 0
+    fed.shutdown()
+
+
+def test_two_party_bf16_push_end_to_end():
+    run_parties(run_bf16_push, ["alice", "bob"])
+
+
+def test_big_endian_source_array_roundtrips_correctly():
+    # The wire declares endianness-less dtype names; a '>f4' source array
+    # must be normalized to native order, not shipped raw.
+    x = np.arange(4, dtype=">f4")
+    out = _roundtrip({"g": x})["g"]
+    np.testing.assert_array_equal(out, np.arange(4, dtype=np.float32))
+
+
+def test_bf16_buffer_is_zero_copy_view():
+    # The downcast leaf's buffer must come from a reinterpret view, not a
+    # tobytes() copy (the feature's hot path would otherwise pay a second
+    # full copy per message).
+    import ml_dtypes
+
+    arr = np.ones(64, np.float32).astype(ml_dtypes.bfloat16)
+    buf = ser._array_buffer(arr)
+    assert isinstance(buf, memoryview)
+    assert buf.nbytes == arr.nbytes
