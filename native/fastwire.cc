@@ -248,7 +248,9 @@ static PyObject *fastwire_pool_trim(PyObject *self, PyObject *args) {
 /* ------------------------------------------------------------------ */
 
 /* sendv(fd, timeout_ms, buffers_sequence) -> None
- * Sends every buffer fully, in order, via writev. */
+ * Sends every buffer fully, in order, via writev — any number of
+ * buffers; the syscalls batch MAX_IOV iovecs at a time (a model
+ * pytree's frame can easily carry hundreds of leaf buffers). */
 static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
     int fd;
     long timeout_ms;
@@ -259,37 +261,37 @@ static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
     PyObject *fast = PySequence_Fast(seq, "buffers must be a sequence");
     if (!fast) return NULL;
     Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
-    if (n > MAX_IOV) {
-        Py_DECREF(fast);
-        PyErr_Format(PyExc_ValueError, "too many buffers (%zd > %d)", n,
-                     MAX_IOV);
-        return NULL;
-    }
 
-    Py_buffer views[MAX_IOV];
-    struct iovec iov[MAX_IOV];
-    Py_ssize_t nviews = 0;
+    std::vector<Py_buffer> views;
+    std::vector<struct iovec> iov;
+    views.reserve((size_t)n);
+    iov.reserve((size_t)n);
     size_t total = 0;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
-        if (PyObject_GetBuffer(item, &views[nviews], PyBUF_C_CONTIGUOUS) < 0) {
-            for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_C_CONTIGUOUS) < 0) {
+            for (auto &v : views) PyBuffer_Release(&v);
             Py_DECREF(fast);
             return NULL;
         }
-        iov[nviews].iov_base = views[nviews].buf;
-        iov[nviews].iov_len = (size_t)views[nviews].len;
-        total += (size_t)views[nviews].len;
-        nviews++;
+        views.push_back(view);
+        struct iovec v;
+        v.iov_base = view.buf;
+        v.iov_len = (size_t)view.len;
+        total += (size_t)view.len;
+        iov.push_back(v);
     }
 
     int err = 0;        /* errno, or -1 for poll timeout */
     size_t sent = 0;
     Py_BEGIN_ALLOW_THREADS;
-    int first = 0;
+    size_t first = 0;
     while (sent < total) {
-        while (first < nviews && iov[first].iov_len == 0) first++;
-        ssize_t rc = writev(fd, &iov[first], (int)(nviews - first));
+        while (first < iov.size() && iov[first].iov_len == 0) first++;
+        int cnt = (int)(iov.size() - first);
+        if (cnt > MAX_IOV) cnt = MAX_IOV;
+        ssize_t rc = writev(fd, &iov[first], cnt);
         if (rc < 0) {
             if (errno == EINTR) continue;
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
@@ -303,7 +305,7 @@ static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
         }
         sent += (size_t)rc;
         size_t done = (size_t)rc;
-        while (done > 0 && first < nviews) {
+        while (done > 0 && first < iov.size()) {
             if (done >= iov[first].iov_len) {
                 done -= iov[first].iov_len;
                 iov[first].iov_len = 0;
@@ -317,7 +319,7 @@ static PyObject *fastwire_sendv(PyObject *self, PyObject *args) {
     }
     Py_END_ALLOW_THREADS;
 
-    for (Py_ssize_t j = 0; j < nviews; j++) PyBuffer_Release(&views[j]);
+    for (auto &v : views) PyBuffer_Release(&v);
     Py_DECREF(fast);
 
     if (err == -1) {

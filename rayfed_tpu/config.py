@@ -161,6 +161,12 @@ class CrossSiloMessageConfig:
     # parity lane, and the device-DMA lane (device-resident pulls never
     # pass through the host codec) are unaffected — all-jax-Array
     # payloads under ``device_dma: true`` ship native precision.
+    # Wire-format note: the downcast rides a tree-meta extension
+    # (``odt``); enable only once EVERY party runs a release that
+    # understands it — an older receiver would deliver raw bf16/fp16
+    # arrays to consumers instead of restored fp32 (deliberately not a
+    # WIRE_VERSION bump: that would reject all cross-version traffic,
+    # including deployments that never enable this opt-in knob).
     payload_wire_dtype: Optional[str] = None
     # Device-DMA data plane on the TPU transport (opt-in): all-jax-Array
     # payloads are pulled device-to-device through a per-party

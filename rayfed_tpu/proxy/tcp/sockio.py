@@ -69,12 +69,17 @@ def send_frame(sock: socket.socket, ftype: int, header: Dict,
     prefix = wire.encode_prefix_and_header(ftype, header, payload_len)
     views = [wire.as_byte_view(b) for b in buffers]
     views = [v for v in views if v.nbytes]
-    if _native_ok(sock) and len(views) < 63:
+    if _native_ok(sock):
         try:
             _fastwire.sendv(sock.fileno(), _timeout_ms(sock), [prefix] + views)
             return
         except TimeoutError:
             raise socket.timeout("fastwire send timed out") from None
+        except ValueError:
+            # Stale v1 extension build: sendv capped at 64 iovecs ("too
+            # many buffers") and nothing has been written yet — fall
+            # through to the Python sendall loop.
+            pass
     sock.sendall(prefix)
     for view in views:
         sock.sendall(view)
