@@ -58,6 +58,7 @@ def make_fed_train_step(
     lr: float = 3e-4,
     remat: bool = False,
     attn: str = "auto",
+    seq_parallel: str = "ring",
     accum_steps: int = 1,
     shard_opt_state: bool = False,
 ):
@@ -72,10 +73,15 @@ def make_fed_train_step(
     flash kernel (O(S) memory, differentiable), ``"xla"`` = the dense
     reference attention, ``"auto"`` (default) = flash on TPU backends,
     dense elsewhere (the kernel's interpret mode is test-speed only).
-    When the ``seq`` axis is sharded, attention runs as ring attention
-    over that axis; with flash selected, each ring step runs through the
-    Pallas kernels (``ring_flash_attention``) so per-device memory stays
-    O(S_local) even at very long context.
+    When the ``seq`` axis is sharded, attention runs sequence-parallel
+    over that axis; ``seq_parallel`` picks the strategy:
+    ``"ring"`` (default) rotates K/V blocks via ``ppermute`` (no cap on
+    the axis size, every hop overlapped with compute; with flash each
+    step runs the Pallas kernels so per-device memory stays O(S_local));
+    ``"a2a"`` is Ulysses-style — one all_to_all to head-sharded layout,
+    the unmodified local kernel over the full sequence, one all_to_all
+    back (fewer collectives at long S; needs n_heads divisible by the
+    axis size).
 
     ``accum_steps > 1`` splits the global batch into that many
     microbatches and accumulates gradients under one ``lax.scan`` —
@@ -91,26 +97,48 @@ def make_fed_train_step(
     update path automatically.
     """
     optimizer = make_optimizer(lr)
-    use_ring = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
+    use_sp = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     if attn not in ("auto", "flash", "xla"):
         raise ValueError(f"attn must be 'auto', 'flash', or 'xla'; got {attn!r}")
+    if seq_parallel not in ("ring", "a2a"):
+        raise ValueError(
+            f"seq_parallel must be 'ring' or 'a2a'; got {seq_parallel!r}"
+        )
     if attn == "auto":
         from rayfed_tpu.utils import is_tpu_backend
 
         attn = "flash" if is_tpu_backend() else "xla"
 
-    if use_ring:
-        # Sequence-parallel attention: shard_map over the seq axis with K/V
-        # ring rotation; every other axis stays GSPMD-automatic.
-        from rayfed_tpu.parallel.ring import ring_flash_attention
+    if use_sp:
+        # Sequence-parallel attention: shard_map over the seq axis;
+        # every other axis stays GSPMD-automatic.
+        if seq_parallel == "a2a":
+            from rayfed_tpu.parallel.ulysses import (
+                make_ulysses_flash,
+                ulysses_attention,
+            )
 
-        block_attn = (
-            functools.partial(ring_flash_attention, axis_name=seq_axis)
-            if attn == "flash"
-            else functools.partial(ring_attention, axis_name=seq_axis)
-        )
+            if cfg.n_heads % mesh.shape[seq_axis] != 0:
+                raise ValueError(
+                    f"seq_parallel='a2a' needs n_heads ({cfg.n_heads}) "
+                    f"divisible by the '{seq_axis}' axis size "
+                    f"({mesh.shape[seq_axis]}); use seq_parallel='ring'"
+                )
+            block_attn = (
+                make_ulysses_flash(seq_axis)
+                if attn == "flash"
+                else functools.partial(ulysses_attention, axis_name=seq_axis)
+            )
+        else:
+            from rayfed_tpu.parallel.ring import ring_flash_attention
 
-        def ring_attn(q, k, v):
+            block_attn = (
+                functools.partial(ring_flash_attention, axis_name=seq_axis)
+                if attn == "flash"
+                else functools.partial(ring_attention, axis_name=seq_axis)
+            )
+
+        def sp_attn(q, k, v):
             pspec = P(None, seq_axis, None, None)
             return shard_map(
                 block_attn,
@@ -121,7 +149,7 @@ def make_fed_train_step(
                 axis_names={seq_axis},
             )(q, k, v)
 
-        attn_fn = ring_attn
+        attn_fn = sp_attn
     elif attn == "flash":
         from rayfed_tpu.ops.flash_attention import make_flash_attn_fn
 
@@ -133,7 +161,7 @@ def make_fed_train_step(
     batch_sharding = NamedSharding(mesh, batch_pspec)
     # Chunked head+CE keeps (B, S, vocab) f32 logits out of HBM; disabled
     # when S is sharded (chunking reshapes the sequence dim).
-    loss_chunk = None if use_ring else 512
+    loss_chunk = None if use_sp else 512
 
     def loss_fn(params, inputs, targets):
         return tfm.lm_loss_pair(

@@ -1,0 +1,115 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""All-to-all (Ulysses-style) sequence parallelism.
+
+The second of the framework's two long-context strategies (the first is
+K/V rotation, :mod:`rayfed_tpu.parallel.ring`): instead of streaming K/V
+blocks around a ring, one ``all_to_all`` reshards the activations from
+sequence-sharded (B, S/n, H, Dh) to head-sharded (B, S, H/n, Dh), each
+device runs ORDINARY causal attention over the full sequence for its
+head subset, and a second ``all_to_all`` reshards back.
+
+Trade-off vs ring (why both exist):
+ - Ulysses moves each of q/k/v/o exactly once (2 collectives of
+   3x + 1x activation bytes) regardless of sequence length; ring moves
+   K/V n-1 times but overlaps every hop with a block of compute.
+ - Ulysses runs the UNMODIFIED local attention kernel (any Pallas/XLA
+   kernel works as-is; no online-softmax merging across steps), so it
+   composes with kernels that cannot be ring-stepped.
+ - Ulysses caps the sequence axis at n <= n_heads (heads must divide);
+   ring has no such cap. Head-dim tensor parallelism also competes with
+   Ulysses for the head axis, while ring composes freely with tp.
+
+On TPU both collectives lower to XLA ``all-to-all`` riding ICI. Used
+inside ``shard_map`` over the sequence axis, like ``ring_attention``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+
+def ulysses_attention(q, k, v, axis_name: str,
+                      inner_attn: Optional[Callable] = None):
+    """Causal attention where (q, k, v) are (B, S_local, H, Dh) shards of
+    the sequence dimension over ``axis_name``; returns the local output
+    shard (B, S_local, H, Dh).
+
+    Must be called inside shard_map/manual-SPMD context over
+    ``axis_name``. ``inner_attn(q, k, v)`` is the full-sequence causal
+    attention run on each device's head subset (default: the model's XLA
+    attention); H must be divisible by the axis size.
+    """
+    if inner_attn is None:
+        from rayfed_tpu.models.transformer import causal_attention
+
+        inner_attn = causal_attention
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses sequence parallelism needs n_heads ({h}) divisible "
+            f"by the '{axis_name}' axis size ({n}); use ring attention "
+            f"for meshes wider than the head count"
+        )
+
+    def seq_to_heads(x):
+        # (B, S/n, H, Dh) -> (B, S, H/n, Dh); chunk j of the concat comes
+        # from ring position j, which holds global positions
+        # [j*S_local, (j+1)*S_local) — device order IS sequence order, so
+        # the gathered sequence is globally ordered and the standard
+        # causal mask applies unchanged.
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    o = inner_attn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
+    return heads_to_seq(o)
+
+
+def make_ulysses_flash(axis_name: str, block_q: int = 512,
+                       block_k: int = 512):
+    """Ulysses with the Pallas flash kernel as the local attention — the
+    kernel runs UNMODIFIED on the full-sequence/head-subset layout (the
+    composability ulysses buys over ring stepping)."""
+    import functools
+
+    from rayfed_tpu.ops.flash_attention import make_flash_attn_fn
+
+    return functools.partial(
+        ulysses_attention, axis_name=axis_name,
+        inner_attn=make_flash_attn_fn(block_q=block_q, block_k=block_k),
+    )
+
+
+def reference_full_attention(q, k, v):
+    """Unsharded causal attention for tests (mirrors ring's helper)."""
+    from rayfed_tpu.models.transformer import causal_attention
+
+    return causal_attention(q, k, v)
+
+
+__all__ = [
+    "ulysses_attention",
+    "make_ulysses_flash",
+    "reference_full_attention",
+]

@@ -83,6 +83,7 @@ _SLOW_TESTS = {
     "test_grad_accumulation_matches_full_batch",
     "test_two_party_checkpoint_resume",
     "test_fed_train_step_with_ring_seq_parallel",
+    "test_fed_train_step_a2a_matches_unsharded_loss",
     "test_incremental_decode_matches_full_forward",
     "test_zero1_sharded_opt_state_matches_replicated",
     "test_pipeline_feeds_train_step",

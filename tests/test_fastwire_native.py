@@ -224,6 +224,9 @@ def test_sendv_batches_past_64_iovecs():
     total = sum(len(x) for x in bufs)
     a, b = _pair()
     with a, b:
+        # Timeout so a sender-side regression (exception swallowed by the
+        # bare thread) fails the test instead of hanging the suite.
+        b.settimeout(10)
         t = threading.Thread(
             target=_fastwire.sendv, args=(a.fileno(), 5000, bufs)
         )
@@ -251,6 +254,7 @@ def test_many_leaf_tree_frame_roundtrips_on_native_path():
     assert kind == "tree" and len(bufs) == 150
     a, b = _pair()
     with a, b:
+        b.settimeout(10)  # fail (not hang) on a sender-side regression
         hdr = {"job": "j", "src": "alice", "up": "1", "down": "1",
                "is_error": False, "pkind": kind, "pmeta": meta}
         t = threading.Thread(

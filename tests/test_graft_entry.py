@@ -77,6 +77,7 @@ def test_dryrun_multichip_under_driver_conditions():
     # (>1) data axis — at n=8 the primary factoring has data=1, so a
     # second party=2 x data=2 section carries it (VERDICT r4 #5).
     assert "dp-composed(party=2, data=2, loss=" in proc.stdout, proc.stdout
+    assert "sp_a2a=True" in proc.stdout, proc.stdout
 
 
 def test_entry_compiles_and_runs():
