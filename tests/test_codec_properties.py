@@ -26,6 +26,7 @@ import numpy as np
 import pytest
 from hypothesis import given, settings, strategies as st
 
+from rayfed_tpu import tree_util
 from rayfed_tpu._private import serialization as ser
 from rayfed_tpu.proxy.tcp import wire
 
@@ -167,3 +168,37 @@ def test_frame_prefix_header_roundtrip(ftype, header, payload_len):
     hdr = msgpack.unpackb(raw[wire.PREFIX_LEN:wire.PREFIX_LEN + hlen],
                           raw=False)
     assert hdr == header
+
+
+@settings(max_examples=80, deadline=None)
+@given(trees(), st.sampled_from(["bf16", "fp16"]))
+def test_wire_dtype_roundtrip_structure_dtype_and_bounds(tree, knob):
+    """Lossy wire precision over ARBITRARY trees: structure identical,
+    every leaf dtype restored, wide-float values within the wire
+    format's error bound, everything else bit-exact."""
+    kind, meta, buffers = ser.encode_payload(
+        tree, wire_dtype=ser.wire_dtype_name(knob)
+    )
+    if kind != "tree":
+        return
+    payload = ser.concat_buffers(buffers)
+    out = ser.decode_payload(kind, meta, payload, allowed_list=None)
+
+    flat_in, spec_in = tree_util.tree_flatten(tree)
+    flat_out, spec_out = tree_util.tree_flatten(out)
+    assert spec_in == spec_out
+    rtol = 2**-8 if knob == "bf16" else 2**-11
+    for a, b in zip(flat_in, flat_out):
+        if isinstance(a, np.ndarray) and a.dtype.kind == "f" \
+                and a.dtype.itemsize > 2:
+            assert b.dtype == a.dtype
+            finite = np.isfinite(a.astype(np.float64))
+            if knob == "fp16":
+                # fp16 overflows past 65504 — bound only in-range values.
+                finite &= np.abs(a.astype(np.float64)) < 6e4
+            np.testing.assert_allclose(
+                b[finite], a[finite], rtol=rtol,
+                atol=(2**-24 if knob == "fp16" else 2**-133),
+            )
+        else:
+            _assert_equal(a, b)
