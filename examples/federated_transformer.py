@@ -52,8 +52,12 @@ class LmWorker:
             np.array(jax.devices()).reshape(n // model_par, model_par),
             ("data", "model"),
         )
+        # donate=False: this worker RETURNS self.params each round for
+        # local aggregation (fed_aggregate consumes it in-party by
+        # reference); donating those buffers into the next step would
+        # invalidate them under the consumer (see make_fed_train_step).
         self._init_fn, self._step_fn = make_fed_train_step(
-            self.cfg, mesh, party_axis=None, lr=1e-2
+            self.cfg, mesh, party_axis=None, lr=1e-2, donate=False
         )
         rng = np.random.default_rng(seed)
         tokens = rng.integers(0, self.cfg.vocab, size=(8, 65))

@@ -61,6 +61,7 @@ def make_fed_train_step(
     seq_parallel: str = "ring",
     accum_steps: int = 1,
     shard_opt_state: bool = False,
+    donate: bool = True,
 ):
     """Build (init_fn, step_fn) jitted over ``mesh``.
 
@@ -291,9 +292,19 @@ def make_fed_train_step(
             opt_state = jax.jit(optimizer.init)(params)
         return params, opt_state
 
+    # ``donate=True`` (default) aliases params/opt_state buffers into the
+    # update — the right memory trade on TPU. Contract (jax's own rule
+    # for aliased values): buffers handed to OTHER consumers must not be
+    # donated afterwards. Cross-party pushes on the socket lanes are
+    # capture-protected (the engine snapshots pushed values at
+    # resolution, barriers.py); under ``device_dma`` donate only after
+    # the send resolves. A fed task that RETURNS its params for LOCAL
+    # consumption (e.g. an actor whose result feeds fed_aggregate in the
+    # same party) must pass donate=False or return a copy — zero-copy
+    # local chaining hands device arrays by reference.
     step_fn = jax.jit(
         step,
         in_shardings=(None, None, batch_sharding, batch_sharding),
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1) if donate else (),
     )
     return init_fn, step_fn

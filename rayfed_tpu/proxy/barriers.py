@@ -238,6 +238,7 @@ def send(
         done.set_result(True)
         return done
     assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
+    data = _capture_for_send(dest_party, data)
     fut = _sender_proxy.send(
         dest_party, data, upstream_seq_id, downstream_seq_id, is_error=is_error
     )
@@ -247,6 +248,133 @@ def send(
             fut, dest_party, upstream_seq_id, downstream_seq_id, is_error
         )
     return fut
+
+
+def _host_snapshot(value):
+    """Capture the jax.Array leaves of ``value`` against later buffer
+    donation: single-device leaves are staged to host numpy (the wire
+    needs those bytes anyway), multi-device leaves get an on-device copy
+    (fresh buffers, sharding preserved — the sharded wire format reads
+    per-shard device views). D2H transfers are started asynchronously
+    for every leaf first, then gathered, so a many-leaf tree pays one
+    overlapped transfer wave rather than serialized per-leaf copies."""
+    import sys
+
+    j = sys.modules.get("jax")
+    if j is None:
+        return value
+    import numpy as np
+
+    from rayfed_tpu import tree_util
+
+    try:
+        leaves, spec = tree_util.tree_flatten(value)
+    except Exception:  # noqa: BLE001 - unflattenable values use pickle lane
+        return value
+    for x in leaves:
+        if isinstance(x, j.Array) and x.is_fully_addressable and len(
+            x.sharding.device_set
+        ) == 1:
+            try:
+                x.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - optional overlap only
+                break
+    out = []
+    for x in leaves:
+        if isinstance(x, j.Array) and x.is_fully_addressable:
+            if len(x.sharding.device_set) == 1:
+                out.append(np.asarray(x))
+            else:
+                try:
+                    # jnp.copy preserves the sharding; the copy's buffers
+                    # are donation-proof.
+                    out.append(j.numpy.copy(x))
+                except Exception:  # noqa: BLE001 - keep original leaf
+                    out.append(x)
+        else:
+            out.append(x)
+    return tree_util.tree_unflatten(out, spec)
+
+
+def _dma_eligible(value) -> bool:
+    """Mirror of the DMA lane's predicate (dma.try_register): a value
+    whose every leaf is a single-device jax.Array."""
+    import sys
+
+    j = sys.modules.get("jax")
+    if j is None:
+        return False
+    from rayfed_tpu import tree_util
+
+    try:
+        leaves, _ = tree_util.tree_flatten(value)
+    except Exception:  # noqa: BLE001
+        return False
+    return bool(leaves) and all(
+        isinstance(x, j.Array)
+        and x.is_fully_addressable
+        and len(x.sharding.device_set) == 1
+        for x in leaves
+    )
+
+
+def _capture_for_send(dest_party: str, data):
+    """Capture the pushed value at RESOLUTION time, Ray-object-store
+    style: the reference snapshots a task's result into the object store
+    when the task completes, so the producer may freely reuse (or, in
+    jax terms, DONATE) its buffers afterwards. This engine hands the
+    send worker live device arrays instead — without this capture, a
+    jitted next step with ``donate_argnums`` invalidates the buffers
+    while the asynchronous send is still waiting to host-stage them
+    ("Array has been deleted", a real race observed in the federated
+    transformer example: train-step N's pushed params donated by step
+    N+1 on the same actor lane).
+
+    jax leaves are captured (host-staged, or device-copied when
+    multi-device) — synchronously for ready values (in program order,
+    before any later donating call), or inside the producing future's
+    resolution callback, which runs on the producer's lane thread BEFORE
+    that lane starts its next task.
+
+    Under ``device_dma``, values ELIGIBLE for the DMA lane (every leaf a
+    single-device jax.Array) are left untouched so they can be parked on
+    the transfer server device-resident — pushed-then-donated buffers on
+    that lane remain the caller's responsibility (registration pins
+    buffers, but it happens in the send worker; donate only after the
+    send future resolves). Values the DMA lane would bounce to the
+    socket anyway (mixed trees, numpy leaves) are captured as usual."""
+    dma_lane = False
+    try:
+        cfg = _sender_proxy.get_proxy_config(dest_party)
+        dma_lane = bool(getattr(cfg, "device_dma", False))
+    except Exception:  # noqa: BLE001 - proxies without per-dest config
+        pass
+
+    def capture(value):
+        # Per-VALUE lane decision: under device_dma only trees the DMA
+        # lane will actually take keep device residency; anything it
+        # would bounce to the socket lane is captured like everywhere
+        # else.
+        if dma_lane and _dma_eligible(value):
+            return value
+        return _host_snapshot(value)
+
+    if not isinstance(data, Future):
+        return capture(data)
+    staged: Future = Future()
+
+    def _resolve(f, out=staged):
+        err = f.exception()
+        if err is not None:
+            out.set_exception(err)
+            return
+        try:
+            out.set_result(capture(f.result()))
+        except BaseException as e:  # noqa: BLE001 - surfaced to drain
+            out.set_exception(e)
+
+    data.add_done_callback(_resolve)
+    return staged
 
 
 def _party_relay_client():
