@@ -1,12 +1,20 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test clean
+.PHONY: native test lint clean
 
 native:
 	python setup.py build_ext --inplace
 
 test:
 	./test.sh
+
+# Static checks: license headers, fedlint over the shipped drivers
+# (must be clean), and the fedlint contract tests (fixture corpus +
+# seq-id validation). Mirrors .github/workflows/fedlint.yml.
+lint:
+	python tools/check_license_headers.py
+	python -m rayfed_tpu.lint examples
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fedlint.py tests/test_seq_id_validation.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

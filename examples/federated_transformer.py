@@ -56,6 +56,8 @@ class LmWorker:
         # local aggregation (fed_aggregate consumes it in-party by
         # reference); donating those buffers into the next step would
         # invalidate them under the consumer (see make_fed_train_step).
+        # fedlint FED003 (donation-aliasing) flags the donate=True
+        # variant of this pattern — docs/fedlint.md.
         self._init_fn, self._step_fn = make_fed_train_step(
             self.cfg, mesh, party_axis=None, lr=1e-2, donate=False
         )

@@ -57,6 +57,16 @@ from rayfed_tpu.utils import setup_logger
 
 logger = logging.getLogger(__name__)
 
+#: Machine-readable anchors for the static analyzer (``rayfed_tpu.lint``):
+#: the public API entry points whose multi-controller contracts fedlint
+#: machine-checks, mapped to the rule ids that guard them (rule catalogue
+#: in docs/fedlint.md). Keep in sync with ``rayfed_tpu.lint.rules``; the
+#: pairing is pinned by ``tests/test_fedlint.py``.
+FEDLINT_ANCHORS = {
+    "get": ("FED001", "FED002"),  # owner-push perimeter; seq-consistent gets
+    "remote": ("FED002", "FED004"),  # identical call sequence; consumed edges
+}
+
 original_sigint = signal.getsignal(signal.SIGINT)
 
 

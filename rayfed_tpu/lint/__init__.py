@@ -1,0 +1,68 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``fedlint``: static analysis for multi-controller federated drivers.
+
+The engine runs ONE copy of the same driver per party (SPMD over
+parties), so a whole class of bugs never shows up in single-process
+tests: control flow that diverges across parties desynchronizes the
+``(upstream_seq_id, downstream_seq_id)`` protocol and deadlocks both
+sides; cross-party pulls violate the owner-pushes data perimeter; and
+``donate=True`` train steps alias buffers into the async send path.
+``fedlint`` checks driver programs for these invariants *before* deploy:
+
+    python -m rayfed_tpu.lint driver.py [more_drivers.py ...]
+
+Rules (see ``docs/fedlint.md`` for the full catalogue):
+
+========  ====================  =============================================
+code      name                  contract checked
+========  ====================  =============================================
+FED001    perimeter             data crosses parties only by owner push
+FED002    seq-divergence        every party issues the same fed-call sequence
+FED003    donation-aliasing     donate=True step results never consumed
+                                locally by reference (train.py contract)
+FED004    dangling-fedobject    every produced FedObject has a consumer
+FED005    reserved-seq-id       ("ping", "ping") seq pair is the readiness
+                                probe, never user data
+========  ====================  =============================================
+
+Findings are suppressible per line with ``# fedlint: disable=<rule>``
+(rule name or code; bare ``disable`` silences every rule on that line)
+and per file with ``# fedlint: disable-file=<rule>``.
+
+This package is dependency-free (stdlib ``ast`` only) so the linter can
+run in CI images and pre-commit hooks that carry no jax/numpy.
+"""
+
+from rayfed_tpu.lint.core import (  # noqa: F401
+    Finding,
+    LintError,
+    Rule,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from rayfed_tpu.lint.rules import ALL_RULES, rule_by_id  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintError",
+    "Rule",
+    "ALL_RULES",
+    "rule_by_id",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

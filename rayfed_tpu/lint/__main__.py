@@ -1,0 +1,84 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""CLI: ``python -m rayfed_tpu.lint <driver.py | dir> ...``
+
+Exit codes: 0 = clean, 1 = findings, 2 = analysis errors (unreadable
+file, syntax error) or usage errors. See ``docs/fedlint.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from rayfed_tpu.lint.core import lint_paths
+from rayfed_tpu.lint.reporters import report_json, report_text
+from rayfed_tpu.lint.rules import ALL_RULES
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rayfed_tpu.lint",
+        description=(
+            "fedlint: static analysis for multi-controller federated "
+            "drivers (data perimeter, seq-id divergence, donation "
+            "aliasing, dangling FedObjects, reserved seq ids)."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", metavar="PATH",
+        help="driver files or directories (directories are walked for .py)",
+    )
+    parser.add_argument(
+        "-f", "--format", choices=("text", "json"), default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--select", action="append", metavar="RULE",
+        help="run only these rules (name or FED code; repeatable)",
+    )
+    parser.add_argument(
+        "--disable", action="append", metavar="RULE",
+        help="skip these rules (name or FED code; repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id}  {rule.name:20s} {rule.summary}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print(
+            "python -m rayfed_tpu.lint: error: no paths given "
+            "(try --list-rules)", file=sys.stderr,
+        )
+        return 2
+
+    result = lint_paths(args.paths, select=args.select, disable=args.disable)
+    if args.format == "json":
+        report_json(result, sys.stdout)
+    else:
+        report_text(result, sys.stdout)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,258 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint rule framework: findings, suppression, and the lint driver.
+
+A rule is a small object with ``rule_id``/``name`` metadata and a
+``check(tree, model)`` generator; the framework owns everything around it
+(parsing, the shared :class:`~rayfed_tpu.lint.model.DriverModel`,
+``# fedlint: disable`` filtering, path walking) so later PRs add a rule
+by dropping one module into ``rayfed_tpu/lint/rules/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from rayfed_tpu.lint.model import DriverModel
+
+#: Directories never descended into when a directory is linted.
+SKIP_DIRS = {
+    ".git", "__pycache__", "build", ".jax_cache", ".jax_test_cache",
+    ".pytest_cache", ".venv", "venv", "node_modules", ".eggs", "dist",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*fedlint:\s*disable(?P<file>-file)?\s*(?:=\s*(?P<rules>[\w\-, ]+))?"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    rule_name: str
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule_id} [{self.rule_name}] {self.message}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class LintError:
+    """A file fedlint could not analyze (unreadable / syntax error)."""
+
+    path: str
+    line: int
+    message: str
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: error: {self.message}"
+
+
+class Rule:
+    """Base class for fedlint rules.
+
+    Subclasses set ``rule_id`` (stable ``FEDnnn`` code), ``name`` (the
+    kebab-case slug accepted by ``# fedlint: disable=``) and ``summary``,
+    and implement :meth:`check` yielding ``(node, message)`` pairs; the
+    framework turns them into :class:`Finding`\\ s.
+    """
+
+    rule_id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        raise NotImplementedError
+
+    def findings(
+        self, path: str, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Finding]:
+        for node, message in self.check(tree, model):
+            yield Finding(
+                path=path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0) + 1,
+                rule_id=self.rule_id,
+                rule_name=self.name,
+                message=message,
+            )
+
+
+class _Suppressions:
+    """Per-line and per-file ``# fedlint: disable`` directives.
+
+    ``# fedlint: disable=<rule>[,<rule>]`` on a finding's line silences
+    those rules there (rule names and FED codes both work; bare
+    ``disable`` silences everything on the line). The ``disable-file``
+    variant applies to the whole file from any line.
+    """
+
+    def __init__(self, source: str):
+        self.file_wide: Set[str] = set()
+        self.by_line: Dict[int, Set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "fedlint" not in text:
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                continue
+            rules = m.group("rules")
+            tokens = (
+                {"*"}
+                if rules is None
+                else {t.strip().lower() for t in rules.split(",") if t.strip()}
+            )
+            if m.group("file"):
+                self.file_wide |= tokens
+            else:
+                self.by_line.setdefault(lineno, set()).update(tokens)
+
+    def suppressed(self, finding: Finding) -> bool:
+        keys = {"*", finding.rule_id.lower(), finding.rule_name.lower()}
+        if keys & self.file_wide:
+            return True
+        return bool(keys & self.by_line.get(finding.line, set()))
+
+
+def _resolve_rules(
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+) -> List[Rule]:
+    from rayfed_tpu.lint.rules import ALL_RULES
+
+    def keyset(tokens: Optional[Iterable[str]]) -> Optional[Set[str]]:
+        if tokens is None:
+            return None
+        return {t.strip().lower() for t in tokens if t.strip()}
+
+    selected, disabled = keyset(select), keyset(disable) or set()
+    out = []
+    for rule in ALL_RULES:
+        keys = {rule.rule_id.lower(), rule.name.lower()}
+        if selected is not None and not (keys & selected):
+            continue
+        if keys & disabled:
+            continue
+        out.append(rule)
+    return out
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[Rule]] = None,
+) -> Tuple[List[Finding], List[LintError]]:
+    """Lint one driver program given as source text."""
+    if rules is None:
+        rules = _resolve_rules()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [], [
+            LintError(path=path, line=e.lineno or 1, message=f"syntax error: {e.msg}")
+        ]
+    model = DriverModel.build(tree)
+    suppress = _Suppressions(source)
+    findings = [
+        f
+        for rule in rules
+        for f in rule.findings(path, tree, model)
+        if not suppress.suppressed(f)
+    ]
+    findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
+    return findings, []
+
+
+def lint_file(
+    path: str, rules: Optional[Sequence[Rule]] = None
+) -> Tuple[List[Finding], List[LintError]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return [], [LintError(path=path, line=1, message=str(e))]
+    return lint_source(source, path=path, rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into the .py files to lint (sorted,
+    deduplicated; directories are walked recursively)."""
+    seen: Set[str] = set()
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        if full not in seen:
+                            seen.add(full)
+                            yield full
+        else:
+            if path not in seen:
+                seen.add(path)
+                yield path
+
+
+@dataclasses.dataclass
+class LintResult:
+    files: List[str]
+    findings: List[Finding]
+    errors: List[LintError]
+
+    @property
+    def exit_code(self) -> int:
+        """0 clean, 1 findings, 2 analysis errors (errors dominate)."""
+        if self.errors:
+            return 2
+        return 1 if self.findings else 0
+
+
+def lint_paths(
+    paths: Iterable[str],
+    select: Optional[Iterable[str]] = None,
+    disable: Optional[Iterable[str]] = None,
+) -> LintResult:
+    """Lint every .py file under ``paths``; the CLI's engine."""
+    rules = _resolve_rules(select=select, disable=disable)
+    files: List[str] = []
+    findings: List[Finding] = []
+    errors: List[LintError] = []
+    for path in iter_python_files(paths):
+        files.append(path)
+        got, bad = lint_file(path, rules=rules)
+        findings.extend(got)
+        errors.extend(bad)
+    return LintResult(files=files, findings=findings, errors=errors)

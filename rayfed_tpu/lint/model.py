@@ -1,0 +1,361 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The driver-program model shared by every fedlint rule.
+
+One pass over the AST recovers how the source spells the
+``fed.init / @fed.remote / .party() / fed.get`` programming model
+(``rayfed_tpu/api.py``): which names alias the ``rayfed_tpu`` module,
+which locals are ``@fed.remote`` tasks/actors, which party this driver
+statically pins itself to (if any), and how to recognize the DAG-building
+call shapes — ``f.party("alice").remote(...)``,
+``actor.method.remote(...)``, ``fed.get(...)``, ``fed_aggregate(...)``,
+``barriers.send/recv(...)``. Rules query the model instead of
+re-implementing import resolution.
+
+Everything here is intentionally conservative: when the model cannot
+prove a fact statically (the party name comes from ``sys.argv``, an
+owner is reassigned in a loop with different parties, ...), it answers
+"unknown" and rules stay silent rather than guess.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+#: Canonical names for the API surface fedlint understands. The resolver
+#: maps whatever the driver imported (``import rayfed_tpu as fed``,
+#: ``from rayfed_tpu.federated import fed_aggregate as agg``, ...) onto
+#: these keys.
+FED_GET = "fed.get"
+FED_INIT = "fed.init"
+FED_REMOTE = "fed.remote"
+FED_AGGREGATE = "fed_aggregate"
+FED_AVG_TRAINER = "FedAvgTrainer"
+MAKE_FED_TRAIN_STEP = "make_fed_train_step"
+BARRIERS_SEND = "barriers.send"
+BARRIERS_RECV = "barriers.recv"
+PING_SEQ_ID = "PING_SEQ_ID"
+
+_TAIL_TO_CANONICAL = {
+    ("get",): FED_GET,
+    ("api", "get"): FED_GET,
+    ("init",): FED_INIT,
+    ("api", "init"): FED_INIT,
+    ("remote",): FED_REMOTE,
+    ("api", "remote"): FED_REMOTE,
+    ("send",): BARRIERS_SEND,
+    ("recv",): BARRIERS_RECV,
+    ("barriers", "send"): BARRIERS_SEND,
+    ("barriers", "recv"): BARRIERS_RECV,
+    ("proxy", "barriers", "send"): BARRIERS_SEND,
+    ("proxy", "barriers", "recv"): BARRIERS_RECV,
+    ("fed_aggregate",): FED_AGGREGATE,
+    ("federated", "fed_aggregate"): FED_AGGREGATE,
+    ("FedAvgTrainer",): FED_AVG_TRAINER,
+    ("federated", "FedAvgTrainer"): FED_AVG_TRAINER,
+    ("make_fed_train_step",): MAKE_FED_TRAIN_STEP,
+    ("train", "make_fed_train_step"): MAKE_FED_TRAIN_STEP,
+    ("parallel", "train", "make_fed_train_step"): MAKE_FED_TRAIN_STEP,
+    ("PING_SEQ_ID",): PING_SEQ_ID,
+    ("constants", "PING_SEQ_ID"): PING_SEQ_ID,
+    ("_private", "constants", "PING_SEQ_ID"): PING_SEQ_ID,
+}
+
+
+@dataclasses.dataclass
+class RemoteInvocation:
+    """A parsed ``....remote(...)`` call shape."""
+
+    node: ast.Call
+    #: party name when the chain carries ``.party("<literal>")``.
+    pinned_party: Optional[str] = None
+    #: True when a ``.party(...)`` pin is present (literal or not).
+    has_party_pin: bool = False
+    #: the base expression the chain hangs off (task name, actor var, ...).
+    base: Optional[ast.expr] = None
+    #: ``base``'s identifier when it is a plain name.
+    base_name: Optional[str] = None
+    #: attribute hop between base and ``.remote`` — an actor method call.
+    method: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Scope:
+    """A lexical scope (module or one function) with its OWN statements —
+    nested function/class bodies belong to their own scopes — plus the
+    full subtree for load lookups (closures count as consumption)."""
+
+    node: ast.AST
+    qualname: str
+    statements: List[ast.stmt]
+
+
+class DriverModel:
+    def __init__(self) -> None:
+        #: local names aliasing the ``rayfed_tpu`` package itself.
+        self.fed_aliases: Set[str] = set()
+        #: local name -> dotted path rooted at ``rayfed_tpu`` for every
+        #: ``import``/``from-import`` of engine modules and symbols.
+        self.import_paths: Dict[str, Tuple[str, ...]] = {}
+        #: names decorated ``@fed.remote`` (plain functions -> tasks).
+        self.remote_functions: Set[str] = set()
+        #: names decorated ``@fed.remote`` (classes -> actor templates).
+        self.remote_classes: Set[str] = set()
+        #: this driver's own party when ``fed.init(party="<literal>")``.
+        self.current_party: Optional[str] = None
+        #: names holding the dynamic party identity (``party=<name>``).
+        self.current_party_vars: Set[str] = set()
+        #: every fed.init call seen (diagnostics / future rules).
+        self.init_calls: List[ast.Call] = []
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def build(cls, tree: ast.Module) -> "DriverModel":
+        model = cls()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                model._take_import(node)
+            elif isinstance(node, ast.ImportFrom):
+                model._take_import_from(node)
+        # Decorators and init calls need import resolution complete first.
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                model._take_decorated(node)
+            elif isinstance(node, ast.Call):
+                if model.canonical_call(node) == FED_INIT:
+                    model._take_init(node)
+        return model
+
+    def _take_import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            parts = alias.name.split(".")
+            if parts[0] != "rayfed_tpu":
+                continue
+            local = alias.asname or parts[0]
+            if alias.asname is None:
+                # ``import rayfed_tpu.proxy.barriers`` binds the ROOT name.
+                self.fed_aliases.add(parts[0])
+            elif len(parts) == 1:
+                self.fed_aliases.add(local)
+            else:
+                self.import_paths[local] = tuple(parts[1:])
+
+    def _take_import_from(self, node: ast.ImportFrom) -> None:
+        if node.level or not node.module:
+            return
+        parts = node.module.split(".")
+        if parts[0] != "rayfed_tpu":
+            return
+        for alias in node.names:
+            local = alias.asname or alias.name
+            self.import_paths[local] = tuple(parts[1:]) + (alias.name,)
+
+    def _take_decorated(self, node: ast.AST) -> None:
+        for deco in getattr(node, "decorator_list", []):
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            if self.canonical(target) == FED_REMOTE:
+                if isinstance(node, ast.ClassDef):
+                    self.remote_classes.add(node.name)
+                else:
+                    self.remote_functions.add(node.name)
+
+    def _take_init(self, node: ast.Call) -> None:
+        self.init_calls.append(node)
+        for kw in node.keywords:
+            if kw.arg != "party":
+                continue
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                self.current_party = kw.value.value
+            elif isinstance(kw.value, ast.Name):
+                self.current_party_vars.add(kw.value.id)
+
+    # ------------------------------------------------------------------
+    # name resolution
+    # ------------------------------------------------------------------
+
+    def resolved_path(self, expr: ast.expr) -> Optional[Tuple[str, ...]]:
+        """Dotted path (relative to ``rayfed_tpu``) an expression names,
+        or None when it does not resolve into the engine's namespace."""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.fed_aliases:
+                return ()
+            return self.import_paths.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolved_path(expr.value)
+            if base is None:
+                return None
+            return base + (expr.attr,)
+        return None
+
+    def canonical(self, expr: ast.expr) -> Optional[str]:
+        """Canonical API name (``fed.get``, ``fed_aggregate``, ...) for an
+        expression, resolved through whatever import spelling the driver
+        used."""
+        path = self.resolved_path(expr)
+        if path is None:
+            return None
+        return _TAIL_TO_CANONICAL.get(path)
+
+    def canonical_call(self, call: ast.Call) -> Optional[str]:
+        return self.canonical(call.func)
+
+    # ------------------------------------------------------------------
+    # call-shape recognizers
+    # ------------------------------------------------------------------
+
+    def remote_invocation(self, call: ast.Call) -> Optional[RemoteInvocation]:
+        """Parse ``<chain>.remote(...)`` DAG-building calls.
+
+        Recognized chains (``.options(...)`` hops allowed anywhere):
+        ``task.party("p").remote(...)``, ``Actor.party("p").remote(...)``,
+        ``handle.method.remote(...)``, ``handles[k].method.remote(...)``.
+        """
+        if not isinstance(call.func, ast.Attribute) or call.func.attr != "remote":
+            return None
+        inv = RemoteInvocation(node=call)
+        cur: ast.expr = call.func.value
+        hops = 0
+        while hops < 32:
+            hops += 1
+            if isinstance(cur, ast.Call) and isinstance(cur.func, ast.Attribute):
+                if cur.func.attr == "party":
+                    inv.has_party_pin = True
+                    if (
+                        cur.args
+                        and isinstance(cur.args[0], ast.Constant)
+                        and isinstance(cur.args[0].value, str)
+                    ):
+                        inv.pinned_party = cur.args[0].value
+                    cur = cur.func.value
+                elif cur.func.attr == "options":
+                    cur = cur.func.value
+                else:
+                    return None  # some other fluent API's .remote
+            elif isinstance(cur, ast.Attribute) and inv.method is None and not (
+                inv.has_party_pin
+            ):
+                inv.method = cur.attr
+                cur = cur.value
+            else:
+                break
+        inv.base = cur
+        if isinstance(cur, ast.Name):
+            inv.base_name = cur.id
+        # An accepted invocation needs SOME fed shape: a .party pin, an
+        # actor-method hop, or a base that is a known @fed.remote name.
+        if (
+            inv.has_party_pin
+            or inv.method is not None
+            or (inv.base_name in self.remote_functions | self.remote_classes)
+        ):
+            return inv
+        return None
+
+    def is_dag_call(self, call: ast.Call) -> bool:
+        """True for calls that advance the fed DAG / seq-id counter:
+        ``.remote(...)`` invocations, ``fed.get``, ``fed_aggregate``,
+        ``FedAvgTrainer(...).run`` and direct barrier sends/recvs."""
+        canon = self.canonical_call(call)
+        if canon in (FED_GET, FED_AGGREGATE, BARRIERS_SEND, BARRIERS_RECV):
+            return True
+        if self.remote_invocation(call) is not None:
+            return True
+        # <trainer>.run(...) — FedAvgTrainer rounds are remote calls.
+        return (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "run"
+            and isinstance(call.func.value, ast.Call)
+            and self.canonical_call(call.func.value) == FED_AVG_TRAINER
+        )
+
+    def contains_dag_call(self, node: ast.AST) -> Optional[ast.Call]:
+        """First DAG-advancing call in a subtree, if any."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and self.is_dag_call(sub):
+                return sub
+        return None
+
+
+# ----------------------------------------------------------------------
+# scopes
+# ----------------------------------------------------------------------
+
+def _own_statements(node: ast.AST) -> List[ast.stmt]:
+    """Statements belonging to ``node``'s scope: its body recursively,
+    stopping at nested function/class definitions (their bodies are
+    separate scopes)."""
+    out: List[ast.stmt] = []
+
+    def walk_body(body: List[ast.stmt]) -> None:
+        for stmt in body:
+            out.append(stmt)
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                walk_body(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                walk_body(handler.body)
+
+    walk_body(getattr(node, "body", []))
+    return out
+
+
+def iter_scopes(tree: ast.Module) -> Iterator[Scope]:
+    """Yield the module scope and every function scope (classes only
+    contribute their methods as scopes, matching Python scoping)."""
+    yield Scope(node=tree, qualname="<module>", statements=_own_statements(tree))
+
+    def visit(node: ast.AST, prefix: str) -> Iterator[Scope]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield Scope(
+                    node=child, qualname=qual, statements=_own_statements(child)
+                )
+                yield from visit(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")
+
+
+def loads_of(scope_node: ast.AST, name: str) -> List[ast.Name]:
+    """Every Load of ``name`` anywhere under the scope (nested scopes
+    included — a closure read counts as consumption)."""
+    return [
+        n
+        for n in ast.walk(scope_node)
+        if isinstance(n, ast.Name) and n.id == name and isinstance(n.ctx, ast.Load)
+    ]
+
+
+def dotted_name(expr: ast.expr) -> Optional[str]:
+    """``self.params`` -> "self.params"; plain names pass through; other
+    shapes (subscripts, calls) return None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return None if base is None else f"{base}.{expr.attr}"
+    return None

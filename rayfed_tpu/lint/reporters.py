@@ -1,0 +1,62 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint output formats: human text and machine JSON.
+
+The JSON shape is stable (CI consumes it):
+
+    {"version": 1,
+     "files": <int>,
+     "findings": [{"path", "line", "col", "rule_id", "rule_name",
+                   "message"}, ...],
+     "errors": [{"path", "line", "message"}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO
+
+from rayfed_tpu.lint.core import LintResult
+
+
+def report_text(result: LintResult, out: IO[str]) -> None:
+    for error in result.errors:
+        out.write(error.render() + "\n")
+    for finding in result.findings:
+        out.write(finding.render() + "\n")
+    n_files = len(result.files)
+    files = f"{n_files} file{'s' if n_files != 1 else ''}"
+    if not result.findings and not result.errors:
+        out.write(f"fedlint: {files} checked, no findings\n")
+    else:
+        parts = []
+        if result.findings:
+            n = len(result.findings)
+            parts.append(f"{n} finding{'s' if n != 1 else ''}")
+        if result.errors:
+            n = len(result.errors)
+            parts.append(f"{n} error{'s' if n != 1 else ''}")
+        out.write(f"fedlint: {files} checked, {', '.join(parts)}\n")
+
+
+def report_json(result: LintResult, out: IO[str]) -> None:
+    payload = {
+        "version": 1,
+        "files": len(result.files),
+        "findings": [f.as_dict() for f in result.findings],
+        "errors": [e.as_dict() for e in result.errors],
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")

@@ -1,0 +1,42 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint rule registry: one module per rule; later PRs extend the
+tuple. Rule ids are stable (suppressions and anchors reference them)."""
+
+from typing import Optional, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.rules.dangling import DanglingFedObjectRule
+from rayfed_tpu.lint.rules.divergence import SeqDivergenceRule
+from rayfed_tpu.lint.rules.donation import DonationAliasingRule
+from rayfed_tpu.lint.rules.perimeter import PerimeterRule
+from rayfed_tpu.lint.rules.reserved_seq import ReservedSeqIdRule
+
+ALL_RULES: Tuple[Rule, ...] = (
+    PerimeterRule(),
+    SeqDivergenceRule(),
+    DonationAliasingRule(),
+    DanglingFedObjectRule(),
+    ReservedSeqIdRule(),
+)
+
+
+def rule_by_id(key: str) -> Optional[Rule]:
+    """Look a rule up by code (``FED003``) or name (``donation-aliasing``)."""
+    key = key.strip().lower()
+    for rule in ALL_RULES:
+        if key in (rule.rule_id.lower(), rule.name.lower()):
+            return rule
+    return None

@@ -1,0 +1,90 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED004 ``dangling-fedobject``: every produced FedObject needs a
+consumer.
+
+A ``.remote(...)`` task invocation (or ``fed_aggregate``) creates a DAG
+edge on EVERY party (each burns the same seq ids); the value only ever
+leaves the producer when some later call consumes it — ``fed.get``, a
+downstream ``.remote`` argument, another aggregate. A FedObject bound to
+a name that is never read again is a dead edge: any bytes already pushed
+for it sit in the receiving party's rendezvous queue forever, and a
+consumer added on one party but not another desynchronizes seq ids
+(see FED002). Deliberate fire-and-forget calls (bare expression
+statements, e.g. ``actor.update.remote(x)`` with no binding) and names
+starting with ``_`` are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import (
+    FED_AGGREGATE,
+    DriverModel,
+    iter_scopes,
+    loads_of,
+)
+
+
+class DanglingFedObjectRule(Rule):
+    rule_id = "FED004"
+    name = "dangling-fedobject"
+    summary = "a FedObject bound to a name that is never consumed"
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for scope in iter_scopes(tree):
+            for stmt in scope.statements:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if not self._produces_fedobject(stmt.value, model):
+                    continue
+                for target in stmt.targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    name = target.id
+                    if name.startswith("_"):
+                        continue
+                    if not loads_of(scope.node, name):
+                        yield (
+                            stmt,
+                            f"FedObject bound to {name!r} is never consumed "
+                            f"(no fed.get, no downstream task argument): "
+                            f"its DAG edge never resolves, so bytes pushed "
+                            f"for it wait in the receiver's queue forever — "
+                            f"consume it, or drop the binding to make the "
+                            f"fire-and-forget explicit",
+                        )
+
+    def _produces_fedobject(self, value: ast.expr, model: DriverModel) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        if model.canonical_call(value) == FED_AGGREGATE:
+            return True
+        inv = model.remote_invocation(value)
+        if inv is None:
+            return False
+        # Actor construction returns a handle, not a FedObject; an unused
+        # handle is not a dangling DAG edge.
+        is_actor_creation = (
+            inv.has_party_pin
+            and inv.method is None
+            and inv.base_name in model.remote_classes
+        )
+        return not is_actor_creation

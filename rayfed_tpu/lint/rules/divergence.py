@@ -1,0 +1,248 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED002 ``seq-divergence``: every party must issue the same fed calls.
+
+Seq ids are allocated by a deterministic per-process counter
+(``get_global_context().next_seq_id()``), so the ``(upstream_seq_id,
+downstream_seq_id)`` protocol in ``rayfed_tpu/proxy/barriers.py`` only
+rendezvouses when every party executes the SAME sequence of
+``fed.remote``/``fed.get`` invocations (multi-controller contract,
+docs/migration_from_rayfed.md "Behavioral contract kept"). A branch that
+fires on one party but not another shifts the counter on that party only
+— after which sender and receiver address different edges and both block
+forever: a distributed deadlock with no error.
+
+Flagged: ``if``/``while``/``for`` statements whose condition (or
+iterable) depends on party identity, ``fed.get`` results, wall-clock
+time, or unseeded randomness, when the branch body issues fed calls (or
+escapes via return/break/continue/raise/sys.exit while the surrounding
+scope issues fed calls). Values that are broadcast-identical on every
+party can make such a branch benign — suppress those sites with
+``# fedlint: disable=seq-divergence`` after review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import (
+    FED_GET,
+    DriverModel,
+    dotted_name,
+    iter_scopes,
+)
+
+#: dotted call prefixes that read the wall clock.
+_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+_ESCAPES = (ast.Return, ast.Break, ast.Continue, ast.Raise)
+
+_EXIT_CALLS = {"sys.exit", "os._exit", "exit", "quit"}
+
+
+class SeqDivergenceRule(Rule):
+    rule_id = "FED002"
+    name = "seq-divergence"
+    summary = (
+        "control flow that differs across parties desynchronizes seq ids "
+        "and deadlocks the send/recv rendezvous"
+    )
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        std_aliases = _std_module_aliases(tree)
+        tainted = _tainted_names(tree, model, std_aliases)
+        for scope in iter_scopes(tree):
+            scope_has_fed = model.contains_dag_call(scope.node) is not None
+            for stmt in scope.statements:
+                yield from self._check_stmt(
+                    stmt, model, tainted, std_aliases, scope_has_fed
+                )
+
+    def _check_stmt(
+        self,
+        stmt: ast.stmt,
+        model: DriverModel,
+        tainted: Set[str],
+        std_aliases: Dict[str, str],
+        scope_has_fed: bool,
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        if isinstance(stmt, (ast.If, ast.While)):
+            guard = stmt.test
+        elif isinstance(stmt, ast.For):
+            guard = stmt.iter
+        else:
+            return
+        reason = _taint_reason(guard, model, tainted, std_aliases)
+        if reason is None:
+            return
+        branch_fed_call = None
+        branch_escape = None
+        for part in list(stmt.body) + list(getattr(stmt, "orelse", [])):
+            if branch_fed_call is None:
+                branch_fed_call = model.contains_dag_call(part)
+            if branch_escape is None:
+                branch_escape = _find_escape(part, model, std_aliases)
+        if branch_fed_call is not None:
+            yield (
+                stmt,
+                f"branch condition depends on {reason} but its body issues "
+                f"fed calls: parties taking different arms issue different "
+                f"fed-call sequences, desynchronizing "
+                f"(upstream_seq_id, downstream_seq_id) and deadlocking the "
+                f"rendezvous — hoist the fed calls out of the branch or "
+                f"make the condition party-invariant",
+            )
+        elif branch_escape is not None and scope_has_fed:
+            yield (
+                stmt,
+                f"branch condition depends on {reason} and can exit the "
+                f"control flow ({branch_escape}) past later fed calls: a "
+                f"party leaving early stops issuing the shared fed-call "
+                f"sequence and strands its peers' rendezvous",
+            )
+
+
+# ----------------------------------------------------------------------
+# taint machinery
+# ----------------------------------------------------------------------
+
+def _std_module_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Aliases for the non-engine modules the rule knows about:
+    time/datetime/random/numpy (plus ``from`` imports of their members,
+    mapped to dotted form)."""
+    interesting = {"time", "datetime", "random", "numpy", "sys", "os"}
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in interesting:
+                    aliases[alias.asname or root] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            root = node.module.split(".")[0]
+            if root in interesting and not node.level:
+                for alias in node.names:
+                    aliases[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+    return aliases
+
+
+def _dotted_call_name(call: ast.Call, aliases: Dict[str, str]) -> str:
+    name = dotted_name(call.func) or ""
+    root, _, rest = name.partition(".")
+    resolved = aliases.get(root)
+    if resolved is not None:
+        return f"{resolved}.{rest}" if rest else resolved
+    return name
+
+
+def _is_divergent_source_call(call: ast.Call, aliases: Dict[str, str]) -> str:
+    """Non-empty reason string when the call reads a party-divergent
+    source (clock / unseeded randomness)."""
+    name = _dotted_call_name(call, aliases)
+    if name in _CLOCK_CALLS:
+        return f"wall-clock time ({name})"
+    if name.startswith("random."):
+        return f"process-local randomness ({name})"
+    if name.startswith("numpy.random."):
+        if name.endswith("default_rng") and call.args:
+            return ""  # explicitly seeded generator
+        return f"process-local randomness ({name})"
+    return ""
+
+
+def _tainted_names(
+    tree: ast.Module, model: DriverModel, aliases: Dict[str, str]
+) -> Set[str]:
+    """Fixpoint over assignments: names derived from the party identity,
+    ``fed.get`` results, clocks, or unseeded randomness. Name-based and
+    scope-insensitive — deliberately coarse for a linter."""
+    tainted: Set[str] = set(model.current_party_vars)
+
+    def expr_tainted(expr: ast.AST) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                if sub.id in tainted:
+                    return True
+            elif isinstance(sub, ast.Call):
+                if model.canonical_call(sub) == FED_GET:
+                    return True
+                if _is_divergent_source_call(sub, aliases):
+                    return True
+        return False
+
+    for _ in range(10):
+        changed = False
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if value is None or not expr_tainted(value):
+                continue
+            for target in targets:
+                for leaf in ast.walk(target):
+                    if isinstance(leaf, ast.Name) and leaf.id not in tainted:
+                        tainted.add(leaf.id)
+                        changed = True
+        if not changed:
+            break
+    return tainted
+
+
+def _taint_reason(
+    expr: ast.expr,
+    model: DriverModel,
+    tainted: Set[str],
+    aliases: Dict[str, str],
+):
+    """Why this guard expression is party-divergent, or None."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            if sub.id in model.current_party_vars:
+                return f"the party identity ({sub.id!r})"
+            if sub.id in tainted:
+                return f"a fed.get-derived or party-dependent value ({sub.id!r})"
+        elif isinstance(sub, ast.Call):
+            if model.canonical_call(sub) == FED_GET:
+                return "a fed.get result"
+            reason = _is_divergent_source_call(sub, aliases)
+            if reason:
+                return reason
+    return None
+
+
+def _find_escape(node: ast.AST, model: DriverModel, aliases: Dict[str, str]):
+    """Name of the first control-flow escape in a subtree, if any."""
+    for sub in ast.walk(node):
+        if isinstance(sub, _ESCAPES):
+            return type(sub).__name__.lower()
+        if isinstance(sub, ast.Call):
+            name = _dotted_call_name(sub, aliases)
+            if name in _EXIT_CALLS:
+                return name
+    return None

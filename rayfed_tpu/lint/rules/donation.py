@@ -1,0 +1,172 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED003 ``donation-aliasing``: donate=True step state must not be
+consumed locally by reference.
+
+``make_fed_train_step(donate=True)`` (the default — see the contract and
+``FEDLINT_DONATION_RULE`` anchor in ``rayfed_tpu/parallel/train.py``)
+aliases the params/opt_state buffers into each update: the NEXT step
+invalidates the previous step's first two outputs. Cross-party pushes
+are capture-protected by the engine (values are snapshotted at
+resolution, ``rayfed_tpu/proxy/barriers.py``), but a fed task that
+RETURNS that state for LOCAL consumption (e.g. an actor whose result
+feeds ``fed_aggregate`` in the same party) hands out live device arrays
+by reference — the next donating step turns them into "Array has been
+deleted" failures that reproduce only under async timing (the race fixed
+in ``tests/test_donation_race.py``).
+
+Flagged: a class that builds a step with ``donate`` left True and
+returns the step's donated outputs (the first two results of the step
+call) from any method. Fix: pass ``donate=False``, or return a copy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import (
+    MAKE_FED_TRAIN_STEP,
+    DriverModel,
+    dotted_name,
+)
+
+#: ``step_fn(params, opt_state, ...) -> (params, opt_state, loss)`` with
+#: ``donate_argnums=(0, 1)``: the first two outputs alias donated inputs.
+_DONATED_RESULTS = 2
+
+
+class DonationAliasingRule(Rule):
+    rule_id = "FED003"
+    name = "donation-aliasing"
+    summary = (
+        "donate=True train-step results returned for local consumption "
+        "alias buffers the next step invalidates"
+    )
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_container(node, model)
+        yield from self._check_container(tree, model)
+
+    def _check_container(
+        self, container: ast.AST, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        """Analyze one class body (or the module minus its classes)."""
+
+        def nodes() -> Iterator[ast.AST]:
+            # Like ast.walk, but nested classes are PRUNED: each class is
+            # its own aliasing domain with its own container pass, so its
+            # members must not leak into this one.
+            stack: List[ast.AST] = [container]
+            while stack:
+                node = stack.pop()
+                yield node
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.ClassDef):
+                        continue
+                    stack.append(child)
+
+        donating_calls: List[ast.Call] = []
+        step_names: Set[str] = set()
+        for sub in nodes():
+            if not isinstance(sub, ast.Assign):
+                continue
+            call = sub.value
+            if (
+                not isinstance(call, ast.Call)
+                or model.canonical_call(call) != MAKE_FED_TRAIN_STEP
+            ):
+                continue
+            if not _donates(call):
+                continue
+            donating_calls.append(call)
+            step = _step_target(sub)
+            if step is not None:
+                step_names.add(step)
+        if not donating_calls or not step_names:
+            return
+
+        aliased: Set[str] = set()
+        for sub in nodes():
+            if not isinstance(sub, ast.Assign) or not isinstance(
+                sub.value, ast.Call
+            ):
+                continue
+            callee = dotted_name(sub.value.func)
+            if callee not in step_names:
+                continue
+            for target in sub.targets:
+                if isinstance(target, ast.Tuple):
+                    for element in target.elts[:_DONATED_RESULTS]:
+                        name = dotted_name(element)
+                        if name is not None:
+                            aliased.add(name)
+                else:
+                    name = dotted_name(target)
+                    if name is not None:
+                        aliased.add(name)
+        if not aliased:
+            return
+
+        for sub in nodes():
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            leaked = _first_reference(sub.value, aliased)
+            if leaked is not None:
+                call = donating_calls[0]
+                yield (
+                    sub,
+                    f"returns {leaked!r}, a donated output of the "
+                    f"donate=True train step built at line {call.lineno}: "
+                    f"a local consumer holds it by reference while the "
+                    f"next step donates (and invalidates) its buffers — "
+                    f"pass donate=False to make_fed_train_step or return "
+                    f"a copy (rayfed_tpu/parallel/train.py aliasing "
+                    f"contract)",
+                )
+
+
+def _donates(call: ast.Call) -> bool:
+    """donate left at its default (True) or explicitly True."""
+    for kw in call.keywords:
+        if kw.arg == "donate":
+            return not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is False
+            )
+    return True
+
+
+def _step_target(assign: ast.Assign) -> Optional[str]:
+    """The step-fn's bound name in ``init_fn, step_fn = make_fed_train_step(...)``
+    (the factory returns the pair; the step is the second element)."""
+    for target in assign.targets:
+        if isinstance(target, ast.Tuple) and len(target.elts) == 2:
+            return dotted_name(target.elts[1])
+    return None
+
+
+def _first_reference(expr: ast.expr, names: Set[str]) -> Optional[str]:
+    for sub in ast.walk(expr):
+        name = dotted_name(sub) if isinstance(
+            sub, (ast.Name, ast.Attribute)
+        ) else None
+        if name in names:
+            return name
+    return None

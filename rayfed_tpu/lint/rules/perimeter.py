@@ -1,0 +1,230 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED001 ``perimeter``: data crosses parties only by owner push.
+
+The engine's perimeter contract (docs/index.md highlight #2): a
+FedObject produced by a task pinned to party A and consumed by a task
+pinned to party B is fine — that is exactly the owner-push lane. What
+violates the perimeter:
+
+* ``fed.get`` of an object whose owner is provably a DIFFERENT party
+  than the one this driver pins itself to via
+  ``fed.init(party="<literal>")`` — a cross-party pull of raw values
+  into this process (drivers whose party comes from ``sys.argv`` run
+  as every party, so ownership is not locally decidable and the rule
+  stays silent);
+* a value already materialized by ``fed.get`` passed back into a
+  ``.remote(...)`` call as a raw argument — the array re-enters the DAG
+  outside the push protocol (every party re-serializes its local copy
+  instead of the owner pushing once), so the FedObject itself should be
+  passed instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import (
+    FED_AGGREGATE,
+    FED_GET,
+    DriverModel,
+    iter_scopes,
+)
+
+#: Sentinel owner for names rebound with conflicting owners.
+_AMBIGUOUS = object()
+
+#: Statement fields holding nested statements — excluded from per-statement
+#: expression walks because the scope's flattened statement list already
+#: visits them individually.
+_STMT_BODY_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+
+def _stmt_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in a statement's OWN expressions (test/iter/value/...), not
+    in nested statement bodies."""
+    for field, value in ast.iter_fields(stmt):
+        if field in _STMT_BODY_FIELDS:
+            continue
+        nodes = value if isinstance(value, list) else [value]
+        for node in nodes:
+            if not isinstance(node, ast.AST):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    yield sub
+
+
+class _Bindings:
+    """Source-order owner tracking for one scope: which party owns the
+    FedObject/actor a name is bound to, and which names hold values
+    already materialized by ``fed.get``."""
+
+    def __init__(self) -> None:
+        self.actor_owner: Dict[str, object] = {}
+        self.fedobj_owner: Dict[str, object] = {}
+        self.materialized: Dict[str, Optional[str]] = {}
+
+    def _bind(self, table: Dict[str, object], name: str, owner: object) -> None:
+        if name in table and table[name] != owner:
+            table[name] = _AMBIGUOUS
+        else:
+            table[name] = owner
+
+    def owner_of(self, name: str) -> Optional[str]:
+        for table in (self.fedobj_owner, self.actor_owner):
+            owner = table.get(name)
+            if owner is _AMBIGUOUS:
+                return None
+            if owner is not None:
+                return owner  # type: ignore[return-value]
+        return None
+
+
+class PerimeterRule(Rule):
+    rule_id = "FED001"
+    name = "perimeter"
+    summary = (
+        "data must cross parties only by owner push, never by pulling "
+        "another party's values or re-injecting materialized arrays"
+    )
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for scope in iter_scopes(tree):
+            yield from self._check_scope(scope.statements, model)
+
+    # ------------------------------------------------------------------
+
+    def _owner_of_expr(
+        self, expr: ast.expr, env: _Bindings, model: DriverModel
+    ) -> Optional[str]:
+        """Literal owner party of a FedObject-producing expression, when
+        statically provable."""
+        if isinstance(expr, ast.Name):
+            return env.owner_of(expr.id)
+        if isinstance(expr, ast.Call):
+            inv = model.remote_invocation(expr)
+            if inv is not None:
+                if inv.pinned_party is not None:
+                    return inv.pinned_party
+                if inv.method is not None and inv.base_name is not None:
+                    owner = env.actor_owner.get(inv.base_name)
+                    return None if owner is _AMBIGUOUS else owner  # type: ignore
+        return None
+
+    def _record_assign(
+        self, stmt: ast.Assign, env: _Bindings, model: DriverModel
+    ) -> None:
+        value = stmt.value
+        targets = []
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                targets.append(t.id)
+        if not targets:
+            return
+        if not isinstance(value, ast.Call):
+            # Aliasing propagates materialization/ownership: x = y.
+            if isinstance(value, ast.Name):
+                for name in targets:
+                    if value.id in env.materialized:
+                        env.materialized[name] = env.materialized[value.id]
+                    owner = env.owner_of(value.id)
+                    if owner is not None:
+                        env._bind(env.fedobj_owner, name, owner)
+            return
+        canon = model.canonical_call(value)
+        if canon == FED_GET:
+            src = value.args[0] if value.args else None
+            src_owner = (
+                self._owner_of_expr(src, env, model) if src is not None else None
+            )
+            for name in targets:
+                env.materialized[name] = src_owner
+            return
+        if canon == FED_AGGREGATE:
+            for name in targets:
+                env._bind(env.fedobj_owner, name, None)
+            return
+        inv = model.remote_invocation(value)
+        if inv is None:
+            return
+        is_actor_creation = (
+            inv.has_party_pin
+            and inv.method is None
+            and inv.base_name in model.remote_classes
+        )
+        table = env.actor_owner if is_actor_creation else env.fedobj_owner
+        owner: object = inv.pinned_party
+        if owner is None and inv.method is not None and inv.base_name:
+            owner = env.actor_owner.get(inv.base_name)
+            if owner is _AMBIGUOUS:
+                owner = None
+        for name in targets:
+            env._bind(table, name, owner)
+
+    def _check_scope(
+        self, statements, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        env = _Bindings()
+        for stmt in statements:
+            if isinstance(stmt, ast.Assign):
+                self._record_assign(stmt, env, model)
+            for call in _stmt_calls(stmt):
+                yield from self._check_get(call, env, model)
+                yield from self._check_raw_arg(call, env, model)
+
+    def _check_get(
+        self, call: ast.Call, env: _Bindings, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        if model.canonical_call(call) != FED_GET or model.current_party is None:
+            return
+        if not call.args:
+            return
+        arg = call.args[0]
+        elements = (
+            list(arg.elts) if isinstance(arg, (ast.List, ast.Tuple)) else [arg]
+        )
+        for element in elements:
+            owner = self._owner_of_expr(element, env, model)
+            if owner is not None and owner != model.current_party:
+                yield (
+                    call,
+                    f"fed.get pulls a value owned by party {owner!r} into "
+                    f"party {model.current_party!r}: data crosses the "
+                    f"perimeter only by owner push (pass the FedObject to "
+                    f"a task pinned to {owner!r}, or have {owner!r} "
+                    f"fed.get its own object to broadcast it)",
+                )
+
+    def _check_raw_arg(
+        self, call: ast.Call, env: _Bindings, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        inv = model.remote_invocation(call)
+        if inv is None:
+            return
+        arg_exprs = list(call.args) + [kw.value for kw in call.keywords]
+        for expr in arg_exprs:
+            if isinstance(expr, ast.Name) and expr.id in env.materialized:
+                yield (
+                    call,
+                    f"argument {expr.id!r} was materialized by fed.get and "
+                    f"re-enters the DAG as a raw value; pass the FedObject "
+                    f"itself so the owner pushes it to the consuming party "
+                    f"instead of every party re-serializing its local copy",
+                )

@@ -1,0 +1,96 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED005 ``reserved-seq-id``: the ``("ping", "ping")`` pair belongs to
+the readiness barrier.
+
+``barriers.send``/``barriers.recv`` address data by
+``(upstream_seq_id, downstream_seq_id)``; the pair ``("ping", "ping")``
+is reserved for the init readiness probe (``PING_SEQ_ID`` in
+``rayfed_tpu/_private/constants.py``) — a frame carrying it is consumed
+by the receiver's rendezvous store as a liveness ping and never
+delivered to ``recv``. Normal drivers never see this (seq ids are
+internal monotonic integers), but code driving the barrier layer
+directly with that pair silently corrupts the handshake: the runtime
+now raises ``ValueError`` (see ``FEDLINT_RESERVED_SEQ_RULE`` in
+``rayfed_tpu/proxy/barriers.py``), and this rule catches it before it
+runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import (
+    BARRIERS_RECV,
+    BARRIERS_SEND,
+    PING_SEQ_ID,
+    DriverModel,
+)
+
+#: positional index of (upstream, downstream/curr) in send(...) and recv(...).
+_SEQ_ARG_POSITIONS = (2, 3)
+_SEQ_KEYWORDS = {
+    BARRIERS_SEND: ("upstream_seq_id", "downstream_seq_id"),
+    BARRIERS_RECV: ("upstream_seq_id", "curr_seq_id"),
+}
+
+
+class ReservedSeqIdRule(Rule):
+    rule_id = "FED005"
+    name = "reserved-seq-id"
+    summary = 'the ("ping", "ping") seq-id pair is the readiness probe'
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = model.canonical_call(node)
+            if canon not in (BARRIERS_SEND, BARRIERS_RECV):
+                continue
+            seq_args = self._seq_args(node, canon)
+            if len(seq_args) == 2 and all(
+                self._is_ping(arg, model) for arg in seq_args
+            ):
+                fn = "send" if canon == BARRIERS_SEND else "recv"
+                yield (
+                    node,
+                    f'barriers.{fn} called with the reserved '
+                    f'("ping", "ping") seq-id pair: that pair is consumed '
+                    f"by the receiver's readiness barrier and never "
+                    f"delivered as data — use any other ids (the runtime "
+                    f"raises ValueError on this collision)",
+                )
+
+    def _seq_args(self, call: ast.Call, canon: str):
+        out = []
+        kw_names = _SEQ_KEYWORDS[canon]
+        keywords = {kw.arg: kw.value for kw in call.keywords}
+        for position, kw_name in zip(_SEQ_ARG_POSITIONS, kw_names):
+            if len(call.args) > position:
+                out.append(call.args[position])
+            elif kw_name in keywords:
+                out.append(keywords[kw_name])
+        return out
+
+    def _is_ping(self, expr: ast.expr, model: DriverModel) -> bool:
+        if isinstance(expr, ast.Constant) and expr.value == "ping":
+            return True
+        if model.canonical(expr) == PING_SEQ_ID:
+            return True
+        return isinstance(expr, ast.Name) and expr.id == "PING_SEQ_ID"

@@ -44,6 +44,15 @@ from rayfed_tpu.parallel import sharding as shd
 from rayfed_tpu.parallel.ring import ring_attention
 
 
+#: Machine-readable anchor for the static analyzer (``rayfed_tpu.lint``):
+#: the fedlint rule that enforces this module's donation-aliasing
+#: contract (``make_fed_train_step(donate=True)`` outputs must not be
+#: returned for local by-reference consumption — see the contract
+#: comment inside ``make_fed_train_step`` and docs/fedlint.md). Pinned
+#: against the rule registry by ``tests/test_fedlint.py``.
+FEDLINT_DONATION_RULE = "FED003"
+
+
 def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.01):
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
 
@@ -301,7 +310,10 @@ def make_fed_train_step(
     # the send resolves. A fed task that RETURNS its params for LOCAL
     # consumption (e.g. an actor whose result feeds fed_aggregate in the
     # same party) must pass donate=False or return a copy — zero-copy
-    # local chaining hands device arrays by reference.
+    # local chaining hands device arrays by reference. This contract is
+    # machine-checked: fedlint rule FEDLINT_DONATION_RULE (module-level
+    # anchor above) flags
+    # drivers that return donated step outputs (docs/fedlint.md).
     step_fn = jax.jit(
         step,
         in_shardings=(None, None, batch_sharding, batch_sharding),

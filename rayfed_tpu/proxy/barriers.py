@@ -42,6 +42,28 @@ from rayfed_tpu.proxy.base import (
 
 logger = logging.getLogger(__name__)
 
+#: Machine-readable anchor for the static analyzer (``rayfed_tpu.lint``):
+#: the ("ping", "ping") seq-id reservation enforced below is lint rule
+#: FED005 (reserved-seq-id, docs/fedlint.md).
+FEDLINT_RESERVED_SEQ_RULE = "FED005"
+
+
+def _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id) -> None:
+    """The ``(PING_SEQ_ID, PING_SEQ_ID)`` pair is the readiness probe: a
+    frame carrying it is consumed by the receiver's rendezvous store as a
+    liveness ping and never delivered as data. Internally generated seq
+    ids are monotonic integers and cannot collide; callers driving this
+    layer directly get a loud error instead of a silently corrupted
+    handshake (fedlint rule FED005)."""
+    if upstream_seq_id == PING_SEQ_ID and downstream_seq_id == PING_SEQ_ID:
+        raise ValueError(
+            f"the seq-id pair ({PING_SEQ_ID!r}, {PING_SEQ_ID!r}) is "
+            f"reserved for the readiness probe and can never carry data "
+            f"(fedlint {FEDLINT_RESERVED_SEQ_RULE}: reserved-seq-id); "
+            f"use any other upstream/downstream seq ids"
+        )
+
+
 # "Current" proxies used by module-level send/recv, plus a name-keyed
 # registry so several jobs' proxies can coexist in one process
 # (ref ``fed/proxy/barriers.py:31-85``: job-suffixed actor names when
@@ -228,8 +250,9 @@ def send(
     barrier: a frame carrying it is consumed by the receiver's rendezvous
     store as a liveness ping and is never delivered to ``recv``. Seq ids
     are generated internally (monotonic integers), so user code never
-    collides with it in normal operation — but callers driving this
-    function directly must not use that pair."""
+    collides with it in normal operation — callers driving this function
+    directly with that pair get a ``ValueError``."""
+    _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id)
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         # Follower host of a multi-host party: the leader's identical
@@ -433,7 +456,9 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
     its arguments and the cross-host jitted computation can proceed.
 
     The seq-id pair ``("ping", "ping")`` is reserved for the readiness
-    barrier (see ``send``); no payload ever arrives under it."""
+    barrier (see ``send``); no payload ever arrives under it, so waiting
+    on it is a ``ValueError``."""
+    _reject_reserved_seq_ids(upstream_seq_id, curr_seq_id)
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         relay = _party_relay_client()

@@ -1,0 +1,50 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED004 dangling FedObject (expected findings: 2).
+
+Two produced-but-never-consumed DAG edges: a pinned task result and an
+aggregate. Nothing ever resolves them — the pushed bytes wait in the
+receiving party's rendezvous queue forever.
+"""
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import fed_aggregate
+
+
+@fed.remote
+def shard_stats(seed):
+    return {"n": seed}
+
+
+def main():
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party="alice",
+    )
+    # BAD: bound, never read — the edge to bob's consumer never forms.
+    stats = shard_stats.party("bob").remote(1)
+    # BAD: the aggregate is computed and dropped.
+    merged = fed_aggregate(
+        {
+            "alice": shard_stats.party("alice").remote(0),
+            "bob": shard_stats.party("bob").remote(2),
+        },
+        op="sum",
+    )
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

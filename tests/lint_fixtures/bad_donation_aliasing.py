@@ -1,0 +1,63 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED003 donation-aliasing (expected findings: 1).
+
+Distilled from tests/test_donation_race.py and the pattern
+examples/federated_transformer.py avoids with donate=False: the worker
+builds its step with donate left at the default (True) and RETURNS the
+step's donated outputs each round for local aggregation — the next
+step's donation invalidates the buffers under the consumer ("Array has
+been deleted", 50%-flaky under async send timing).
+"""
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import fed_aggregate
+from rayfed_tpu.parallel.train import make_fed_train_step
+
+ROUNDS = 3
+
+
+@fed.remote
+class LeakyWorker:
+    def __init__(self, cfg, mesh, rng, tokens):
+        # BAD: donate defaults to True while train() returns self.params.
+        self._init_fn, self._step_fn = make_fed_train_step(
+            cfg, mesh, party_axis=None, lr=1e-2
+        )
+        self.params, self.opt_state = self._init_fn(rng, tokens)
+        self.inputs, self.targets = tokens[:, :-1], tokens[:, 1:]
+
+    def train(self, global_params):
+        if global_params is not None:
+            self.params = global_params
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, self.inputs, self.targets
+        )
+        self._loss = float(loss)
+        return self.params
+
+
+def main(cfg, mesh, rng, tokens):
+    workers = {
+        p: LeakyWorker.party(p).remote(cfg, mesh, rng, tokens)
+        for p in ("alice", "bob")
+    }
+    global_params = None
+    for _ in range(ROUNDS):
+        locals_ = {p: workers[p].train.remote(global_params) for p in workers}
+        # The in-party leg of fed_aggregate consumes the owner's params
+        # BY REFERENCE — the buffers the next donating step deletes.
+        global_params = fed_aggregate(locals_, op="mean")
+    print(fed.get(global_params))

@@ -1,0 +1,50 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED001 perimeter violations (expected findings: 2).
+
+This driver statically pins itself to party "alice" yet pulls bob's raw
+value into its process, then re-injects the materialized array into the
+DAG as a plain argument.
+"""
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def produce():
+    return [1.0, 2.0, 3.0]
+
+
+@fed.remote
+def consume(x):
+    return sum(x)
+
+
+def main():
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party="alice",
+    )
+    theirs = produce.party("bob").remote()
+    # BAD: alice pulls a bob-owned value across the perimeter.
+    value = fed.get(theirs)
+    # BAD: the materialized array re-enters the DAG as a raw argument.
+    total = consume.party("alice").remote(value)
+    print(fed.get(total))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

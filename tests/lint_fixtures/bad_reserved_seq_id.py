@@ -1,0 +1,35 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED005 reserved seq id (expected findings: 2).
+
+Code driving the barrier layer directly with the ("ping", "ping") pair —
+reserved for the readiness probe; such frames are consumed by the
+receiver's rendezvous store and never delivered as data.
+"""
+
+from rayfed_tpu.proxy import barriers
+
+
+def leak_a_probe_frame():
+    # BAD: collides with the readiness probe; the payload vanishes into
+    # the ping accounting and the matching recv never resolves.
+    return barriers.send("bob", b"payload", "ping", "ping")
+
+
+def wait_on_probe_frame():
+    # BAD: no payload ever arrives under the reserved pair.
+    return barriers.recv(
+        "alice", "bob", upstream_seq_id="ping", curr_seq_id="ping"
+    )

@@ -1,0 +1,61 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED002 seq-divergence (expected findings: 2).
+
+Branches on party identity and on a fed.get-derived metric issue fed
+calls in only one arm: the party taking the branch burns seq ids its
+peers never allocate, desynchronizing the rendezvous protocol.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def metric():
+    return 0.7
+
+
+@fed.remote
+def cleanup():
+    return None
+
+
+@fed.remote
+def extra_round():
+    return None
+
+
+def main():
+    party = sys.argv[1]
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+    )
+    m = fed.get(metric.party("alice").remote())
+    # BAD: only alice issues this call — bob's seq counter falls behind.
+    if party == "alice":
+        cleanup.party("alice").remote()
+    # BAD: a fed.get-derived guard around fed calls (benign only when the
+    # value is provably broadcast-identical on every party).
+    if m > 0.5:
+        more = extra_round.party("bob").remote()
+        print(fed.get(more))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

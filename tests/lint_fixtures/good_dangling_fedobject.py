@@ -1,0 +1,58 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED004 negative case (expected findings: 0).
+
+Every bound FedObject is consumed (fed.get or a downstream task), and
+the deliberate fire-and-forget call stays a bare expression statement —
+the explicit idiom examples/split_learning.py uses for
+``bottom.backward.remote(...)``.
+"""
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import fed_aggregate
+
+
+@fed.remote
+def shard_stats(seed):
+    return {"n": seed}
+
+
+@fed.remote
+class Logger:
+    def record(self, value):
+        return None
+
+
+def main():
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party="alice",
+    )
+    merged = fed_aggregate(
+        {
+            "alice": shard_stats.party("alice").remote(0),
+            "bob": shard_stats.party("bob").remote(2),
+        },
+        op="sum",
+    )
+    log = Logger.party("alice").remote()
+    # GOOD: explicit fire-and-forget — no binding, no dangling edge.
+    log.record.remote(merged)
+    print(fed.get(merged))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED003 negative cases (expected findings: 0).
+
+Two safe shapes: a worker that returns step state but opts out of
+donation (donate=False, the examples/federated_transformer.py choice),
+and a worker that keeps donation ON but only ever returns the scalar
+loss (not a donated output).
+"""
+
+import rayfed_tpu as fed
+from rayfed_tpu.parallel.train import make_fed_train_step
+
+
+@fed.remote
+class SafeReturningWorker:
+    def __init__(self, cfg, mesh, rng, tokens):
+        # GOOD: donate=False because train() returns self.params for
+        # local consumption (fedlint FED003 / donation-aliasing).
+        self._init_fn, self._step_fn = make_fed_train_step(
+            cfg, mesh, party_axis=None, lr=1e-2, donate=False
+        )
+        self.params, self.opt_state = self._init_fn(rng, tokens)
+        self.inputs, self.targets = tokens[:, :-1], tokens[:, 1:]
+
+    def train(self, global_params):
+        if global_params is not None:
+            self.params = global_params
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, self.inputs, self.targets
+        )
+        return self.params
+
+
+@fed.remote
+class DonatingLossOnlyWorker:
+    def __init__(self, cfg, mesh, rng, tokens):
+        # GOOD: donate stays True (the right TPU memory trade) — the
+        # donated outputs never leave the actor; only the fresh scalar
+        # loss does.
+        self._init_fn, self._step_fn = make_fed_train_step(
+            cfg, mesh, party_axis=None, lr=1e-2
+        )
+        self.params, self.opt_state = self._init_fn(rng, tokens)
+        self.inputs, self.targets = tokens[:, :-1], tokens[:, 1:]
+
+    def train(self):
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, self.inputs, self.targets
+        )
+        return float(loss)

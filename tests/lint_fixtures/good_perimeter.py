@@ -1,0 +1,51 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED001 negative case (expected findings: 0).
+
+Cross-party data flows as FedObjects through the owner-push lane: bob's
+result feeds alice's task as a FedObject argument, and the driver's
+party identity is dynamic (the same script runs on every party).
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def produce():
+    return [1.0, 2.0, 3.0]
+
+
+@fed.remote
+def consume(x):
+    return sum(x)
+
+
+def main():
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=sys.argv[1],
+    )
+    theirs = produce.party("bob").remote()
+    # GOOD: the FedObject crosses as a push; bob's value lands only in
+    # alice's executing task.
+    total = consume.party("alice").remote(theirs)
+    print(fed.get(total))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

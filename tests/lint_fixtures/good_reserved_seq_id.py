@@ -1,0 +1,32 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED005 negative case (expected findings: 0).
+
+Direct barrier-layer use with ordinary seq ids (the engine's own are
+monotonic integers); "ping" in only ONE slot is unusual but does not
+collide with the reserved ("ping", "ping") probe pair.
+"""
+
+from rayfed_tpu.proxy import barriers
+
+
+def push_one(edge_id):
+    return barriers.send("bob", b"payload", edge_id, edge_id + 1)
+
+
+def pull_one(edge_id):
+    return barriers.recv(
+        "alice", "bob", upstream_seq_id=edge_id, curr_seq_id=edge_id + 1
+    )

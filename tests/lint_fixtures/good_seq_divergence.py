@@ -1,0 +1,49 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED002 negative case (expected findings: 0).
+
+Every party issues the identical fed-call sequence; party identity only
+selects which locally-known value to PRINT (no fed calls inside
+party-dependent control flow), the multi-controller idiom used by
+examples/fedavg_lora.py.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def metric(seed):
+    return 0.5 + seed
+
+
+def main():
+    party = sys.argv[1]
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+    )
+    # Both parties issue BOTH calls: identical DAGs, identical seq ids.
+    m_alice = metric.party("alice").remote(0)
+    m_bob = metric.party("bob").remote(1)
+    got_alice, got_bob = fed.get([m_alice, m_bob])
+    mine = got_alice if party == "alice" else got_bob
+    print(f"[{party}] my metric: {mine}")
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

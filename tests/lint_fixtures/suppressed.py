@@ -1,0 +1,52 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: suppression mechanics (expected findings: 0).
+
+Each would-be finding carries a ``# fedlint: disable=<rule>`` directive
+— by rule name on one site, by FED code on the other.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def metric():
+    return 0.7
+
+
+@fed.remote
+def cleanup():
+    return None
+
+
+def main():
+    party = sys.argv[1]
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+    )
+    # Reviewed: both parties see the same broadcast value, so the branch
+    # arms match everywhere.
+    m = fed.get(metric.party("alice").remote())
+    if m > 0.5:  # fedlint: disable=seq-divergence
+        cleanup.party("alice").remote()
+    audit = metric.party("bob").remote()  # fedlint: disable=FED004
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
