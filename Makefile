@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint clean
+.PHONY: native test lint chaos clean
 
 native:
 	python setup.py build_ext --inplace
@@ -15,6 +15,14 @@ lint:
 	python tools/check_license_headers.py
 	python -m rayfed_tpu.lint examples
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fedlint.py tests/test_seq_id_validation.py -q
+
+# Chaos/failure lane (docs/resilience.md): the seeded fault-schedule
+# FedAvg run plus the multi-process failure-path tests. Slow by design
+# (real timeouts, spawned parties) — mirrors the `chaos` job in
+# .github/workflows/tests.yml.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest \
+	  tests/test_resilience.py tests/test_failure_paths.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -58,6 +58,23 @@ _FAST_RETRY = {
     }
 }
 
+# Set by _run_two_party in the parent; spawned parties overwrite their
+# mark file at each phase boundary so a hang is diagnosable (a party
+# terminated by the timeout can't report anything itself — BENCH_r05
+# recorded exactly such an undiagnosable "bench party hung").
+_PROGRESS_DIR_VAR = "FEDTPU_BENCH_PROGRESS_DIR"
+
+
+def _progress(party: str, phase: str) -> None:
+    d = os.environ.get(_PROGRESS_DIR_VAR)
+    if not d:
+        return
+    try:
+        with open(os.path.join(d, f"{party}.progress"), "w") as f:
+            f.write(phase)
+    except OSError:
+        pass  # diagnostics must never fail the measurement
+
 
 def _party_main(party, addresses, transport, result_path, device_dma=False,
                 pair_ceiling=False):
@@ -115,8 +132,10 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
 
     # Connection warmup (the measurement loop below carries its own
     # discarded warmup cycles).
+    _progress(party, "init done; connection warmup")
     w = consume.party("bob").remote(produce.party("alice").remote(-1.0))
     assert fed.get(w) == -2.0
+    _progress(party, "warmup done")
 
     # Paired-ceiling rig: a dedicated raw socket between the SAME two
     # party processes. Each rep runs a raw sendall/recv_into window
@@ -180,6 +199,7 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
     warmup_reps = 3
     n_reps = PAIRED_REPS if pair_ceiling else REPS
     for rep in range(-warmup_reps, n_reps):
+        _progress(party, f"rep {rep}/{n_reps}")
         # Materialize all tensors at alice BEFORE the timed window so the
         # measurement is transport throughput, not producer memset speed.
         base = 100.0 * rep
@@ -252,6 +272,7 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
                  "raw_samples": raw_samples},
                 f,
             )
+    _progress(party, "reps done; shutting down")
     fed.shutdown()
 
 
@@ -400,9 +421,11 @@ def _tiny_party(party, addresses, transport, result_path, rounds):
         return a + b
 
     # Warmup (connection + executor spin-up).
+    _progress(party, "init done; warmup")
     fed.get(aggregate.party("alice").remote(
         inc.party("alice").remote(0), inc.party("bob").remote(0)))
 
+    _progress(party, "timed rounds")
     t0 = time.perf_counter()
     acc = 0
     for _ in range(rounds):
@@ -410,6 +433,7 @@ def _tiny_party(party, addresses, transport, result_path, rounds):
         b = inc.party("bob").remote(acc)
         acc = fed.get(aggregate.party("alice").remote(a, b))
     dt = time.perf_counter() - t0
+    _progress(party, "rounds done; shutting down")
     # 3 fed tasks + 1 get per round (the reference harness's accounting,
     # ref benchmarks/many_tiny_tasks_benchmark.py:48-59).
     if party == "alice":
@@ -464,10 +488,13 @@ def _fedavg_party(party, addresses, transport, result_path, rounds):
         worker_args={"alice": (1,), "bob": (2,)},
     )
     # Warmup round (actor init, first push).
+    _progress(party, "init done; warmup round")
     global_params = fed.get(trainer.run(1))
+    _progress(party, "timed rounds")
     t0 = time.perf_counter()
     final = fed.get(trainer.run(rounds, global_params))
     dt = time.perf_counter() - t0
+    _progress(party, "rounds done; shutting down")
     assert np.isfinite(np.asarray(final[0]).sum())
     if party == "alice":
         with open(result_path, "w") as f:
@@ -494,8 +521,15 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
             )
             for party in parties
         ]
-        for p in procs:
-            p.start()
+        # Children inherit the env at spawn; each party overwrites
+        # {tmp}/{party}.progress at phase boundaries (_progress) so a
+        # hang below can say WHICH phase each party last reached.
+        os.environ[_PROGRESS_DIR_VAR] = tmp
+        try:
+            for p in procs:
+                p.start()
+        finally:
+            os.environ.pop(_PROGRESS_DIR_VAR, None)
         for p in procs:
             p.join(timeout=timeout_s)
         hung = [p for p in procs if p.is_alive()]
@@ -503,7 +537,16 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
             p.terminate()
             p.join(timeout=30)
         if hung:
-            raise RuntimeError("bench party hung; terminated")
+            marks = {}
+            for party in parties:
+                try:
+                    with open(os.path.join(tmp, f"{party}.progress")) as f:
+                        marks[party] = f.read().strip() or "no mark"
+                except OSError:
+                    marks[party] = "no mark"
+            raise RuntimeError(
+                f"bench party hung; terminated (last phase marks: {marks})"
+            )
         for p in procs:
             if p.exitcode != 0:
                 raise RuntimeError(f"bench party failed ({p.exitcode})")
@@ -574,11 +617,14 @@ def _hier4_party(party, addresses, transport, result_path, rounds):
         assert float(np.asarray(out["g"])[0]) == expect
         return out
 
+    _progress(party, "init done; warmup round")
     one_round(-1)  # warmup (connections, executor)
+    _progress(party, "timed rounds")
     t0 = time.perf_counter()
     for r in range(rounds):
         one_round(r)
     dt = time.perf_counter() - t0
+    _progress(party, "rounds done; shutting down")
     if party == "alice":
         with open(result_path, "w") as f:
             json.dump({"round_ms": dt / rounds * 1000}, f)
@@ -634,10 +680,13 @@ def _cnn_party(party, addresses, transport, result_path, rounds):
         worker_args={"alice": (1,), "bob": (2,)},
     )
     # Warmup round absorbs actor init + the jit compile.
+    _progress(party, "init done; warmup round (jit compile)")
     global_params = fed.get(trainer.run(1))
+    _progress(party, "timed rounds")
     t0 = time.perf_counter()
     final = fed.get(trainer.run(rounds, global_params))
     dt = time.perf_counter() - t0
+    _progress(party, "rounds done; shutting down")
     assert all(
         np.isfinite(np.asarray(leaf)).all()
         for leaf in (final["head"]["w"], final["dense"]["w"])

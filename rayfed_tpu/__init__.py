@@ -34,6 +34,12 @@ from rayfed_tpu.api import (  # noqa: F401
 from rayfed_tpu.exceptions import FedRemoteError  # noqa: F401
 from rayfed_tpu.fed_object import FedObject  # noqa: F401
 from rayfed_tpu.proxy.barriers import recv, send  # noqa: F401
+from rayfed_tpu.resilience import (  # noqa: F401
+    MISSING,
+    fault_trace,
+    liveness_view,
+    party_state,
+)
 
 __version__ = "0.1.0"
 
@@ -48,5 +54,9 @@ __all__ = [
     "recv",
     "FedObject",
     "FedRemoteError",
+    "MISSING",
+    "fault_trace",
+    "liveness_view",
+    "party_state",
     "__version__",
 ]

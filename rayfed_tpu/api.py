@@ -303,6 +303,30 @@ def init(
             init_timeout_s=collective_dict.get("init_timeout_s", 120.0),
         )
 
+    # Resilience wiring (docs/resilience.md), leader-only — followers own
+    # no proxies to inject into or probe from. The fault injector wraps
+    # the just-started sender proxy BEFORE the readiness barrier so a
+    # schedule can exercise init-time faults too; the liveness monitor
+    # starts last, its heartbeats riding the same (possibly injected)
+    # sender, so a partitioned link takes the heartbeats down with the
+    # data.
+    resilience_dict = config.get("resilience") or {}
+    if resilience_dict and party_process_id == 0:
+        from rayfed_tpu.resilience import inject as _inject
+        from rayfed_tpu.resilience import liveness as _liveness
+
+        schedule_dict = resilience_dict.get("fault_schedule")
+        if schedule_dict is not None:
+            _inject.install(
+                _inject.FaultSchedule.from_dict(schedule_dict), party
+            )
+        liveness_dict = resilience_dict.get("liveness")
+        if liveness_dict is not None:
+            _liveness.start_monitor(
+                [p for p in addresses if p != party],
+                _liveness.LivenessConfig.from_dict(liveness_dict),
+            )
+
     if config.get("barrier_on_initializing", False) and party_process_id == 0:
         barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
 
@@ -348,6 +372,16 @@ def _shutdown(intended: bool = True):
 
     internal_kv.kv_reset()
     clear_global_context(wait_for_sending=wait_for_sending)
+    # Resilience teardown before the proxies go away: heartbeats must not
+    # probe a stopped sender, and uninstalling the injector restores the
+    # real proxy so stop_proxies stops what init started. The modules are
+    # always importable here (config.py pulls the package in), and both
+    # calls are no-ops when init never enabled them.
+    from rayfed_tpu.resilience import inject as _inject
+    from rayfed_tpu.resilience import liveness as _liveness
+
+    _liveness.stop_monitor()
+    _inject.uninstall()
     barriers.stop_proxies(job_name=ctx.get_job_name())
     # Only touch the collective lane if it was ever imported — keeps jax
     # out of control-plane-only processes.
@@ -491,10 +525,51 @@ def fed_utils_is_cython(obj) -> bool:
 
 def get(
     fed_objects: Union[FedObject, List[FedObject]],
+    *,
+    timeout: Optional[float] = None,
+    on_missing: str = "raise",
+    default: Any = None,
 ) -> Any:
     """Resolve FedObjects to real values; the owner broadcasts to every
     other party (ref api.py:531-608 — `get` is itself a DAG node with a
-    fresh seq id so all parties address the same edges)."""
+    fresh seq id so all parties address the same edges).
+
+    Degraded-mode keywords (docs/resilience.md; all keyword-only so the
+    reference-shaped positional call keeps meaning what it always did):
+
+    - ``timeout``: wall-clock budget in seconds shared across ALL the
+      requested objects (a round with several missing contributors costs
+      one timeout, not one each). None = wait forever (legacy).
+    - ``on_missing``: what a missing value — recv deadline expired,
+      retries exhausted, injected fault — turns into. ``"raise"``
+      (default) propagates the failure; ``"drop"`` removes missing
+      entries from a list result; ``"default"`` substitutes ``default``
+      (``fed.MISSING`` if left at None). A ``FedRemoteError`` envelope
+      always re-raises regardless: the peer was alive and its task
+      *failed*, which no aggregation should silently average over.
+    - ``default``: the substitute under ``on_missing="default"``. None
+      means the :data:`rayfed_tpu.MISSING` sentinel, which
+      ``ops.aggregate.elastic_weighted_mean`` skips natively.
+
+    Multi-controller caveat: like every fed API, the SAME call (same
+    keywords) must run on every party — a party that drops while another
+    raises diverges the program.
+    """
+    from rayfed_tpu.resilience.degraded import (
+        MISSING,
+        resolve_with_policy,
+        validate_on_missing,
+    )
+
+    validate_on_missing(on_missing)
+    if isinstance(fed_objects, FedObject) and on_missing == "drop":
+        raise ValueError(
+            "on_missing='drop' needs a list of FedObjects (there is "
+            "nothing to drop a single result into); use "
+            "on_missing='default' for a single object"
+        )
+    if default is None:
+        default = MISSING
     # get() is itself a node in the DAG: it burns one seq id so every
     # party addresses the broadcast edges identically.
     consumer_seq_id = get_global_context().next_seq_id()
@@ -537,7 +612,16 @@ def get(
             futures.append(fut)
 
     try:
-        values = [f.result() for f in futures]
+        if timeout is None and on_missing == "raise":
+            # Legacy fast path, bit-for-bit: block forever per future.
+            values = [f.result() for f in futures]
+        else:
+            values, missing = resolve_with_policy(
+                futures, timeout, on_missing, default
+            )
+            if on_missing == "drop":
+                gone = set(missing)
+                values = [v for i, v in enumerate(values) if i not in gone]
         return values[0] if single else values
     except FedRemoteError as e:
         logger.warning(

@@ -140,6 +140,13 @@ class CrossSiloMessageConfig:
 
     timeout_in_ms: int = 60000
     recv_timeout_in_ms: Optional[int] = None
+    # Wall-clock budget for one outbound push, shared across ALL of its
+    # retry attempts (dial + stream + backoffs). None (default) keeps the
+    # legacy shape where only per-attempt timeouts bound a send; set it
+    # so a send against a dead peer fails after a predictable total
+    # rather than attempts x timeout. Enforced by the unified retry
+    # engine (resilience/retry.py) on the native TCP/TPU lanes.
+    send_deadline_in_ms: Optional[int] = None
     messages_max_size_in_bytes: Optional[int] = None
     serializing_allowed_list: Optional[Dict[str, List[str]]] = None
     allow_pickle_payloads: bool = True
@@ -212,36 +219,11 @@ class CrossSiloMessageConfig:
         return cls(**{k: v for k, v in data.items() if k in field_names})
 
 
-@dataclasses.dataclass
-class RetryPolicy:
-    """Connection/send retry policy, mirroring the reference's gRPC service
-    config defaults (ref ``grpc_options.py:19-25``): 5 attempts, 5s initial
-    backoff, 30s cap, x2 multiplier."""
-
-    max_attempts: int = 5
-    initial_backoff_ms: int = 5000
-    max_backoff_ms: int = 30000
-    backoff_multiplier: float = 2.0
-
-    @classmethod
-    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "RetryPolicy":
-        data = data or {}
-        # Accept the reference's camelCase gRPC retry keys too.
-        alias = {
-            "maxAttempts": "max_attempts",
-            "initialBackoff": "initial_backoff_ms",
-            "maxBackoff": "max_backoff_ms",
-            "backoffMultiplier": "backoff_multiplier",
-        }
-
-        def conv(k: str, v: Any) -> Any:
-            if k in ("initialBackoff", "maxBackoff") and isinstance(v, str):
-                return int(float(v.rstrip("s")) * 1000)
-            return v
-
-        norm = {alias.get(k, k): conv(k, v) for k, v in data.items()}
-        field_names = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in norm.items() if k in field_names})
+# RetryPolicy moved to the unified retry engine (resilience/retry.py) so
+# every transport shares one backoff implementation; re-exported here
+# because config dicts and call sites historically spell it
+# ``rayfed_tpu.config.RetryPolicy``.
+from rayfed_tpu.resilience.retry import RetryPolicy  # noqa: E402,F401
 
 
 @dataclasses.dataclass

@@ -94,3 +94,50 @@ def tree_weighted_mean(trees, weights, acc_dtype: Optional[str] = "float32"):
     if len(trees) == 1:
         return trees[0]
     return _tree_weighted_mean(tuple(trees), tuple(weights), acc_dtype=acc_dtype)
+
+
+def elastic_weighted_mean(
+    contributions,
+    weights=None,
+    liveness=None,
+    acc_dtype: Optional[str] = "float32",
+):
+    """Degraded-mode FedAvg: the weighted mean over SURVIVING
+    contributors, re-normalized so the aggregate stays an average of what
+    actually arrived (docs/resilience.md).
+
+    ``contributions`` is ``{party: tree_or_missing}``. A contributor is
+    dropped when its value is absent — None or the ``fed.MISSING``
+    sentinel, i.e. what ``fed.get(..., on_missing="default")`` yields for
+    a lost push — or when ``liveness`` (a ``{party: state}`` view from
+    ``fed.liveness_view()``) marks it DEAD. The DEAD check matters even
+    when the value DID arrive: a partitioned peer's stale round-k update
+    averaged into round k+n is worse than no update (the classic
+    straggler-poisoning failure), so the liveness verdict wins.
+
+    ``weights`` maps party -> sample count (uniform when None). Raises
+    ``ValueError`` when no contributor survives — an empty average has no
+    meaningful value, and silently returning zeros would train on them.
+
+    Survivor fold order is party-name order, independent of which subset
+    survived, so the same surviving set produces bitwise-identical
+    aggregates on every party (the determinism contract above).
+    """
+    from rayfed_tpu.resilience.degraded import MISSING
+    from rayfed_tpu.resilience.liveness import DEAD
+
+    liveness = liveness or {}
+    survivors = [
+        p for p in sorted(contributions)
+        if contributions[p] is not None
+        and contributions[p] is not MISSING
+        and liveness.get(p) != DEAD
+    ]
+    if not survivors:
+        raise ValueError(
+            "no surviving contributors to aggregate: all values missing "
+            "or their parties marked DEAD"
+        )
+    trees = [contributions[p] for p in survivors]
+    w = [1.0 if weights is None else weights[p] for p in survivors]
+    return tree_weighted_mean(trees, w, acc_dtype=acc_dtype)

@@ -108,6 +108,36 @@ def receiver_proxy() -> Optional[ReceiverProxy]:
     return _receiver_proxy
 
 
+def swap_sender_proxy(new_proxy) -> None:
+    """Replace the current sender proxy in place — the seam the fault
+    injector (resilience/inject.py) wraps and unwraps through. Registry
+    entries pointing at the old object are updated too, so
+    ``stop_proxies`` at shutdown stops the wrapper (which delegates) and
+    never leaves a stale entry behind. Note a SenderReceiverProxy is
+    registered (and stopped) once but swapped only on its sender role —
+    the receiver half keeps pointing at the inner object."""
+    global _sender_proxy
+    old = _sender_proxy
+    _sender_proxy = new_proxy
+    if old is None:
+        return
+    for name, obj in list(_proxy_registry.items()):
+        if obj is old:
+            _proxy_registry[name] = new_proxy
+
+
+def send_ping(dest_party: str) -> Future:
+    """Push one readiness/liveness ping to ``dest_party`` through the
+    current sender proxy. The receiver's rendezvous store acks the
+    reserved ``(PING_SEQ_ID, PING_SEQ_ID)`` frame without delivering
+    anything; the returned future resolves truthy on ack. Shared by the
+    ``ping_others`` init barrier and the liveness monitor's heartbeats —
+    one probe format, one code path, and it rides the (possibly
+    injector-wrapped) data lane so probes see the same faults data does."""
+    assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
+    return _sender_proxy.send(dest_party, PING_SEQ_ID, PING_SEQ_ID, PING_SEQ_ID)
+
+
 def _default_transport_classes(transport: str):
     if transport in ("tcp", "tpu"):
         # 'tpu' layers device placement on arrival on top of the TCP wire;
@@ -633,9 +663,7 @@ def ping_others(
         for p in sorted(others - reached):
             fut = pending.get(p)
             if fut is None:
-                pending[p] = _sender_proxy.send(
-                    p, PING_SEQ_ID, PING_SEQ_ID, PING_SEQ_ID
-                )
+                pending[p] = send_ping(p)
                 fut = pending[p]
             try:
                 budget = max(0.05, deadline - time.monotonic())

@@ -53,6 +53,7 @@ from rayfed_tpu.exceptions import FedLocalError
 from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
 from rayfed_tpu.proxy.grpc import fedproto
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
+from rayfed_tpu.resilience.retry import grpc_retry_policy
 
 logger = logging.getLogger(__name__)
 
@@ -67,15 +68,11 @@ def _identity(b: bytes) -> bytes:
 
 
 def _channel_options(config: TcpCrossSiloMessageConfig):
-    policy = config.get_retry_policy()
     max_msg = config.effective_max_message_bytes() or -1  # -1: gRPC unlimited
-    retry = {
-        "maxAttempts": policy.max_attempts,
-        "initialBackoff": f"{policy.initial_backoff_ms / 1000}s",
-        "maxBackoff": f"{policy.max_backoff_ms / 1000}s",
-        "backoffMultiplier": policy.backoff_multiplier,
-        "retryableStatusCodes": ["UNAVAILABLE"],
-    }
+    # Rendered pre-clamped to gRPC core's maxAttempts cap of 5 — larger
+    # values would work but print "retry_service_config.cc: Clamped
+    # retryPolicy.maxAttempts at 5" to stderr on every channel build.
+    retry = grpc_retry_policy(config.get_retry_policy())
     return [
         ("grpc.max_send_message_length", max_msg),
         ("grpc.max_receive_message_length", max_msg),

@@ -37,6 +37,7 @@ event-loop streaming tops out ~20x lower (see sockio.py).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import socket
 import ssl
@@ -63,8 +64,15 @@ from rayfed_tpu.proxy.base import (
 )
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
 from rayfed_tpu.proxy.tcp import sockio, wire
+from rayfed_tpu.resilience.retry import Deadline, run_with_retry
 
 logger = logging.getLogger(__name__)
+
+
+class _ConnectExhausted(Exception):
+    """Internal marker: the dial inside a stream attempt already ran its
+    whole retry budget — abort the stream loop and surface the dial's
+    ConnectionError (its ``__cause__``) unchanged."""
 
 
 def _parse_addr(addr: str) -> Tuple[str, int]:
@@ -135,33 +143,33 @@ class _DestWorker(threading.Thread):
         )
         return raw
 
-    def _connect_retry(self, max_attempts: Optional[int],
-                       op_timeout) -> socket.socket:
-        """Connect with the retry policy. ``op_timeout`` is the blocking-op
-        timeout installed on the resulting socket (-1 = config default)."""
+    def _connect_retry(self, max_attempts: Optional[int], op_timeout,
+                       deadline: Optional[Deadline] = None) -> socket.socket:
+        """Connect via the unified retry engine (resilience/retry.py).
+        ``op_timeout`` is the blocking-op timeout installed on the
+        resulting socket (-1 = config default); ``deadline`` is the
+        enclosing send's total wall-clock budget, shared with the stream
+        attempts that follow."""
         policy = self._cfg.get_retry_policy()
-        attempts = max_attempts or policy.max_attempts
-        backoff = policy.initial_backoff_ms / 1000
-        last_err: Optional[Exception] = None
-        for attempt in range(attempts):
-            try:
-                return self._connect_once(op_timeout=op_timeout)
-            except OSError as e:
-                last_err = e
-                logger.debug(
-                    "connect to %s failed (attempt %d/%d): %s",
-                    self._dest, attempt + 1, attempts, e,
-                )
-                if attempt + 1 < attempts:
-                    time.sleep(backoff)
-                    backoff = min(
-                        backoff * policy.backoff_multiplier,
-                        policy.max_backoff_ms / 1000,
-                    )
-        raise ConnectionError(
-            f"cannot reach party {self._dest} at "
-            f"{self._proxy._addresses[self._dest]} after {attempts} "
-            f"attempts: {last_err}"
+        if max_attempts is not None:
+            policy = dataclasses.replace(policy, max_attempts=max_attempts)
+
+        def on_retry(attempt: int, err: BaseException) -> None:
+            logger.debug(
+                "connect to %s failed (attempt %d/%d): %s",
+                self._dest, attempt, policy.max_attempts, err,
+            )
+
+        return run_with_retry(
+            lambda attempt: self._connect_once(op_timeout=op_timeout),
+            policy,
+            retry_on=(OSError,),
+            deadline=deadline,
+            describe=(
+                f"cannot reach party {self._dest} at "
+                f"{self._proxy._addresses[self._dest]}"
+            ),
+            on_retry=on_retry,
         )
 
     def _fresh_sock(self, max_attempts: Optional[int] = None) -> socket.socket:
@@ -173,10 +181,13 @@ class _DestWorker(threading.Thread):
             max_attempts, op_timeout=self._cfg.timeout_in_ms / 1000
         )
 
-    def _get_sock(self, max_attempts: Optional[int] = None) -> socket.socket:
+    def _get_sock(self, max_attempts: Optional[int] = None,
+                  deadline: Optional[Deadline] = None) -> socket.socket:
         if self._sock is not None:
             return self._sock
-        self._sock = self._connect_retry(max_attempts, op_timeout=-1)
+        self._sock = self._connect_retry(
+            max_attempts, op_timeout=-1, deadline=deadline
+        )
         return self._sock
 
     def _drop_sock(self) -> None:
@@ -297,44 +308,57 @@ class _DestWorker(threading.Thread):
         return header, buffers, payload_len, None
 
     def _send_half_duplex(self, header, buffers) -> bool:
-        # TLS path. Send with bounded reconnect: first attempt gets the
+        # TLS path, on the unified retry engine. First attempt gets the
         # full connect budget (peer may still be starting — the reference
         # rides gRPC's in-channel retry policy for this), a reconnect
         # after a stale connection gets one try, so the total budget
-        # stays ~2x the policy rather than attempts^2.
+        # stays ~2x the policy rather than attempts^2. An optional
+        # send_deadline_in_ms bounds dial + stream + backoffs together.
         cfg = self._cfg
         policy = cfg.get_retry_policy()
-        backoff = policy.initial_backoff_ms / 1000
-        last_err: Optional[BaseException] = None
-        for attempt in range(policy.max_attempts):
-            sock = self._get_sock(max_attempts=None if attempt == 0 else 1)
+        deadline = Deadline.from_ms(cfg.send_deadline_in_ms)
+
+        def attempt_stream(attempt: int):
+            try:
+                sock = self._get_sock(
+                    max_attempts=None if attempt == 1 else 1,
+                    deadline=deadline,
+                )
+            except ConnectionError as e:
+                # The dial already exhausted its own retry budget —
+                # re-dialing per stream attempt would square it.
+                raise _ConnectExhausted() from e
             try:
                 sockio.send_frame(sock, wire.FTYPE_DATA, header, buffers)
-                ftype, resp, _ = sockio.recv_frame(
+                return sockio.recv_frame(
                     sock, max_payload=wire.MAX_RESP_FRAME
                 )
-                break
             except socket.timeout:
+                # The peer accepted the connection but stalled past the
+                # per-op timeout: the caller's timeout contract says fail
+                # now, a fresh socket would just stall again.
                 self._drop_sock()
                 raise
-            except (OSError, ConnectionError, ssl.SSLError) as e:
+            except OSError as e:  # covers ConnectionError, ssl.SSLError
                 self._drop_sock()
-                last_err = e
                 logger.debug(
-                    "send to %s failed on stale connection (attempt %d/%d): %s",
-                    self._dest, attempt + 1, policy.max_attempts, e,
+                    "send to %s failed on stale connection "
+                    "(attempt %d/%d): %s",
+                    self._dest, attempt, policy.max_attempts, e,
                 )
-                if attempt + 1 < policy.max_attempts:
-                    time.sleep(backoff)
-                    backoff = min(
-                        backoff * policy.backoff_multiplier,
-                        policy.max_backoff_ms / 1000,
-                    )
-        else:
-            raise ConnectionError(
-                f"send to {self._dest} failed after "
-                f"{policy.max_attempts} attempts: {last_err}"
+                raise
+
+        try:
+            ftype, resp, _ = run_with_retry(
+                attempt_stream,
+                policy,
+                retry_on=(OSError,),
+                give_up_on=(_ConnectExhausted, socket.timeout),
+                deadline=deadline,
+                describe=f"send to {self._dest}",
             )
+        except _ConnectExhausted as e:
+            raise e.__cause__ from None
 
         self._proxy._bump_stat("send_op_count")
         if ftype != wire.FTYPE_RESP:

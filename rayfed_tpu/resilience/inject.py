@@ -1,0 +1,449 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Deterministic fault injection at the sender-proxy seam.
+
+A :class:`FaultSchedule` is a seed plus a list of rules; an
+:class:`InjectingSenderProxy` wraps ANY transport's sender (tcp/grpc/tpu
+— the seam is :class:`~rayfed_tpu.proxy.base.SenderProxy`) and applies
+the schedule to each outbound frame. Every per-frame decision is a pure
+function of ``sha256(seed, rule_index, src, dst, upstream_seq_id,
+downstream_seq_id)`` — and, in the multi-controller model, seq ids are
+monotonic integers generated in identical program order on every party —
+so a chaos run replays bit-for-bit: same seed, same faults, same trace.
+
+Fault kinds (rule ``fault`` key):
+
+- ``drop``       — the send future fails with :class:`InjectedFault`;
+  the frame never reaches the wire.
+- ``delay``      — the frame is forwarded after a deterministic pause in
+  ``[0, max_delay_ms]``.
+- ``duplicate``  — the frame is forwarded twice (the receiver's
+  rendezvous dedup must absorb it).
+- ``corrupt``    — numpy-array leaves get one deterministically chosen
+  bit flipped before forwarding.
+- ``partition``  — one-way src→dst blackhole: every send (pings
+  included, by default) fails while the dst's data-send index is inside
+  ``[after, after + for)``.
+- ``crash``      — the party stops transmitting: after ``after`` total
+  data sends, every outbound send fails forever.
+
+Probabilistic rules (drop/delay/duplicate/corrupt) skip readiness/
+liveness pings by default — faulting the handshake probabilistically
+makes startup timing-dependent; structural rules (partition/crash)
+include pings by default, because a partitioned link drops heartbeats
+too (that is exactly how the liveness monitor is meant to find out).
+Either default is overridable per-rule with ``"pings": true/false``.
+
+Window positions (``after``/``for``) are counted on the per-destination
+DATA-send index, never on pings: ping counts depend on barrier timing
+and would make replays diverge.
+
+Injected faults are recorded as ``ok=False`` spans of kind ``"fault"``
+in :mod:`rayfed_tpu.tracing` and appended to an in-order trace queryable
+via :func:`fault_trace` (data frames only — ping faults are counted but
+not traced, again for determinism).
+
+Stdlib + numpy only; no jax, no transport imports at module scope.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+from rayfed_tpu import tracing
+from rayfed_tpu._private.constants import PING_SEQ_ID
+
+logger = logging.getLogger(__name__)
+
+FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "partition", "crash")
+
+# Probabilistic faults default to data frames only; structural faults
+# (a cut link, a dead process) hit pings too.
+_PING_DEFAULT = {"partition": True, "crash": True}
+
+
+class InjectedFault(ConnectionError):
+    """A send failure manufactured by the fault-injection layer.
+
+    Subclasses ``ConnectionError`` so every existing failure path —
+    retry exhaustion handling, sending-failure handlers, degraded-mode
+    ``on_missing`` classification — treats it exactly like a real
+    transport failure."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One line of a fault schedule. Unknown dict keys are rejected
+    loudly — a typo'd ``"porb"`` silently matching nothing would make a
+    chaos suite vacuously green."""
+
+    fault: str
+    src: Optional[str] = None        # match sender party; None = any
+    dst: Optional[str] = None        # match destination; None = any
+    prob: float = 1.0                # drop/delay/duplicate/corrupt
+    max_delay_ms: int = 100          # delay
+    after: int = 0                   # partition/crash window start
+    duration: Optional[int] = None   # partition: window length; None = forever
+    pings: Optional[bool] = None     # None = per-fault default
+    _ALIASES = {"for": "duration"}
+
+    def __post_init__(self) -> None:
+        if self.fault not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.fault!r}; expected one of "
+                f"{FAULT_KINDS}"
+            )
+        if not 0.0 <= self.prob <= 1.0:
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "FaultRule":
+        norm = {cls._ALIASES.get(k, k): v for k, v in data.items()}
+        field_names = {
+            f.name for f in dataclasses.fields(cls) if not f.name.startswith("_")
+        }
+        unknown = set(norm) - field_names
+        if unknown:
+            raise ValueError(
+                f"unknown fault-rule key(s) {sorted(unknown)}; valid keys: "
+                f"{sorted(field_names | set(cls._ALIASES))}"
+            )
+        return cls(**norm)
+
+    def applies_to_pings(self) -> bool:
+        if self.pings is not None:
+            return self.pings
+        return _PING_DEFAULT.get(self.fault, False)
+
+
+@dataclasses.dataclass
+class FaultSchedule:
+    """A seed plus an ordered rule list. The first matching rule that
+    fires wins for a given frame (drop beats delay beats duplicate only
+    by list order — put the severe ones first)."""
+
+    seed: int = 0
+    rules: List[FaultRule] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "FaultSchedule":
+        data = data or {}
+        rules = [
+            r if isinstance(r, FaultRule) else FaultRule.from_dict(r)
+            for r in data.get("rules", [])
+        ]
+        return cls(seed=int(data.get("seed", 0)), rules=rules)
+
+
+def _u01(seed: int, rule_idx: int, src: str, dst: str, up, down) -> float:
+    """Uniform [0, 1) decision value, a pure function of the frame key."""
+    h = hashlib.sha256(
+        f"{seed}|{rule_idx}|{src}|{dst}|{up}|{down}".encode()
+    ).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+def _corrupt_value(value, seed: int, src: str, dst: str, up, down):
+    """Flip one deterministically chosen bit in each numpy-array leaf of
+    ``value`` (containers walked structurally; non-array leaves pass
+    through — pickle-lane corruption would just be a decode error, the
+    interesting case is a silently wrong tensor)."""
+    import numpy as np
+
+    def walk(x, path: str):
+        if isinstance(x, np.ndarray) and x.size and x.dtype != object:
+            flat = bytearray(np.ascontiguousarray(x).tobytes())
+            h = hashlib.sha256(
+                f"corrupt|{seed}|{src}|{dst}|{up}|{down}|{path}".encode()
+            ).digest()
+            bit = int.from_bytes(h[:8], "big") % (len(flat) * 8)
+            flat[bit // 8] ^= 1 << (bit % 8)
+            return np.frombuffer(bytes(flat), dtype=x.dtype).reshape(x.shape)
+        if isinstance(x, dict):
+            return {k: walk(v, f"{path}.{k}") for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            out = [walk(v, f"{path}[{i}]") for i, v in enumerate(x)]
+            return type(x)(out) if isinstance(x, tuple) else out
+        return x
+
+    return walk(value, "$")
+
+
+class InjectingSenderProxy:
+    """Wraps an inner :class:`~rayfed_tpu.proxy.base.SenderProxy` (or the
+    sender half of a SenderReceiverProxy) and applies a
+    :class:`FaultSchedule` to every outbound frame. Transparent for
+    everything else: attribute access falls through to the inner proxy,
+    so per-dest config lookups (``get_proxy_config``), stats, and
+    ``stop`` keep working."""
+
+    def __init__(self, inner, schedule: FaultSchedule, party: str) -> None:
+        self._inner = inner
+        self._schedule = schedule
+        self._party = party
+        self._lock = threading.Lock()
+        self._data_idx: Dict[str, int] = {}   # per-dest data-send index
+        self._total_data_sends = 0
+        self._trace: List[Dict[str, Any]] = []
+        self._ping_faults = 0
+        self._crashed = False
+
+    # -- delegation ---------------------------------------------------
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    @property
+    def inner(self):
+        return self._inner
+
+    def start(self) -> None:
+        self._inner.start()
+
+    def stop(self) -> None:
+        self._inner.stop()
+
+    def get_stats(self) -> Dict:
+        stats = dict(self._inner.get_stats())
+        with self._lock:
+            stats["injected_faults"] = len(self._trace) + self._ping_faults
+        return stats
+
+    # -- the interesting part -----------------------------------------
+    def send(
+        self,
+        dest_party: str,
+        data,
+        upstream_seq_id,
+        downstream_seq_id,
+        is_error: bool = False,
+    ) -> Future:
+        is_ping = (
+            upstream_seq_id == PING_SEQ_ID
+            and downstream_seq_id == PING_SEQ_ID
+        )
+        with self._lock:
+            if is_ping:
+                idx = self._data_idx.get(dest_party, 0)
+            else:
+                idx = self._data_idx.get(dest_party, 0)
+                self._data_idx[dest_party] = idx + 1
+                self._total_data_sends += 1
+            total = self._total_data_sends
+        decision = self._decide(
+            dest_party, upstream_seq_id, downstream_seq_id, is_ping, idx, total
+        )
+        if decision is None:
+            return self._inner.send(
+                dest_party, data, upstream_seq_id, downstream_seq_id,
+                is_error=is_error,
+            )
+        rule_idx, rule, delay_s = decision
+        self._record(
+            rule, rule_idx, dest_party, upstream_seq_id, downstream_seq_id,
+            is_ping,
+        )
+        if rule.fault in ("drop", "partition", "crash"):
+            fut: Future = Future()
+            fut.set_exception(InjectedFault(
+                f"injected {rule.fault}: {self._party}->{dest_party} "
+                f"({upstream_seq_id}, {downstream_seq_id})"
+            ))
+            return fut
+        if rule.fault == "corrupt":
+            data = self._corrupt(
+                data, dest_party, upstream_seq_id, downstream_seq_id
+            )
+            return self._inner.send(
+                dest_party, data, upstream_seq_id, downstream_seq_id,
+                is_error=is_error,
+            )
+        if rule.fault == "duplicate":
+            self._inner.send(
+                dest_party, data, upstream_seq_id, downstream_seq_id,
+                is_error=is_error,
+            )
+            return self._inner.send(
+                dest_party, data, upstream_seq_id, downstream_seq_id,
+                is_error=is_error,
+            )
+        # delay: forward from a timer thread; chain the real send's
+        # completion into the future the caller already holds.
+        out: Future = Future()
+
+        def fire() -> None:
+            try:
+                real = self._inner.send(
+                    dest_party, data, upstream_seq_id, downstream_seq_id,
+                    is_error=is_error,
+                )
+            except BaseException as e:  # noqa: BLE001 - surfaced to drain
+                out.set_exception(e)
+                return
+
+            def chain(f: Future) -> None:
+                err = f.exception()
+                if err is not None:
+                    out.set_exception(err)
+                else:
+                    out.set_result(f.result())
+
+            real.add_done_callback(chain)
+
+        timer = threading.Timer(delay_s, fire)
+        timer.daemon = True
+        timer.start()
+        return out
+
+    def _decide(
+        self, dst: str, up, down, is_ping: bool, idx: int, total: int
+    ) -> Optional[Tuple[int, FaultRule, float]]:
+        """First firing rule for this frame, or None. Returns
+        (rule_index, rule, delay_seconds)."""
+        for i, rule in enumerate(self._schedule.rules):
+            if rule.src is not None and rule.src != self._party:
+                continue
+            if rule.dst is not None and rule.dst != dst:
+                continue
+            if is_ping and not rule.applies_to_pings():
+                continue
+            if rule.fault == "partition":
+                end = (
+                    None if rule.duration is None
+                    else rule.after + rule.duration
+                )
+                if idx >= rule.after and (end is None or idx < end):
+                    return i, rule, 0.0
+                continue
+            if rule.fault == "crash":
+                if self._crashed or total > rule.after:
+                    self._crashed = True
+                    return i, rule, 0.0
+                continue
+            u = _u01(self._schedule.seed, i, self._party, dst, up, down)
+            if u >= rule.prob:
+                continue
+            if rule.fault == "delay":
+                frac = _u01(
+                    self._schedule.seed, i + 0x10000, self._party, dst, up,
+                    down,
+                )
+                return i, rule, (rule.max_delay_ms / 1000.0) * frac
+            return i, rule, 0.0
+        return None
+
+    def _corrupt(self, data, dst: str, up, down):
+        seed = self._schedule.seed
+        if isinstance(data, Future):
+            out: Future = Future()
+
+            def chain(f: Future, o=out) -> None:
+                err = f.exception()
+                if err is not None:
+                    o.set_exception(err)
+                    return
+                try:
+                    o.set_result(
+                        _corrupt_value(f.result(), seed, self._party, dst,
+                                       up, down)
+                    )
+                except BaseException as e:  # noqa: BLE001
+                    o.set_exception(e)
+
+            data.add_done_callback(chain)
+            return out
+        return _corrupt_value(data, seed, self._party, dst, up, down)
+
+    def _record(
+        self, rule: FaultRule, rule_idx: int, dst: str, up, down,
+        is_ping: bool,
+    ) -> None:
+        tracing.record(
+            "fault", dst, str(up), str(down), 0, time.perf_counter(),
+            ok=False,
+        )
+        if is_ping:
+            # Ping cadence is timing-dependent; tracing ping faults would
+            # make same-seed traces diverge between runs.
+            with self._lock:
+                self._ping_faults += 1
+            return
+        with self._lock:
+            self._trace.append({
+                "fault": rule.fault,
+                "rule": rule_idx,
+                "src": self._party,
+                "dst": dst,
+                "up": str(up),
+                "down": str(down),
+            })
+
+    def fault_trace(self) -> List[Dict[str, Any]]:
+        """Injected data-frame faults, in send order. Deterministic for a
+        fixed (seed, driver program): same seed ⇒ identical list."""
+        with self._lock:
+            return list(self._trace)
+
+
+# -- install / uninstall at the barriers seam -------------------------
+
+_installed: Optional[InjectingSenderProxy] = None
+
+
+def install(schedule: FaultSchedule, party: str) -> InjectingSenderProxy:
+    """Wrap the current sender proxy (post-``fed.init`` proxy startup)
+    in an injector. Idempotent per init: installing twice replaces the
+    previous schedule rather than double-wrapping."""
+    global _installed
+    from rayfed_tpu.proxy import barriers
+
+    inner = barriers.sender_proxy()
+    assert inner is not None, "sender proxy not started; call fed.init() first"
+    if isinstance(inner, InjectingSenderProxy):
+        inner = inner.inner
+    injector = InjectingSenderProxy(inner, schedule, party)
+    barriers.swap_sender_proxy(injector)
+    _installed = injector
+    logger.info(
+        "fault injection installed: seed=%d, %d rule(s)",
+        schedule.seed, len(schedule.rules),
+    )
+    return injector
+
+
+def uninstall() -> None:
+    """Unwrap the injector, restoring the real sender proxy. The last
+    trace stays readable via :func:`fault_trace` until the next install."""
+    global _installed
+    from rayfed_tpu.proxy import barriers
+
+    current = barriers.sender_proxy()
+    if isinstance(current, InjectingSenderProxy):
+        barriers.swap_sender_proxy(current.inner)
+
+
+def get_injector() -> Optional[InjectingSenderProxy]:
+    return _installed
+
+
+def fault_trace() -> List[Dict[str, Any]]:
+    """The installed (or most recently installed) injector's data-frame
+    fault trace, in send order; [] when injection was never enabled."""
+    return [] if _installed is None else _installed.fault_trace()

@@ -321,6 +321,59 @@ def test_store_records_ping_sources():
         store.shutdown()
 
 
+def run_recv_timeout_dead_peer(party, addresses, transport, q):
+    import time
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "transport": transport,
+            "cross_silo_comm": {
+                **FAST_COMM_CONFIG,
+                "recv_timeout_in_ms": 2000,
+            },
+        },
+    )
+    t0 = time.monotonic()
+    fut = fed.recv(party, "bob", 1, 1)
+    try:
+        fut.result(timeout=60)
+        q.put(("no-error", 0.0))
+    except Exception as e:  # noqa: BLE001
+        q.put((type(e).__name__, time.monotonic() - t0))
+    fed.shutdown()
+
+
+@pytest.mark.parametrize("transport", ["tcp", "grpc", "tpu"])
+def test_recv_from_dead_peer_times_out(transport):
+    """A recv whose peer never starts fails with TimeoutError after
+    recv_timeout_in_ms on EVERY transport — bounded, not a hang. The
+    timeout fires in the local rendezvous store, so the semantics must
+    not depend on which wire carries the data (docs/resilience.md)."""
+    if transport == "grpc":
+        pytest.importorskip("grpc")
+    addresses = get_addresses(["alice", "bob"])
+    q = multiprocessing.get_context("spawn").Queue()
+    alice = MP.Process(
+        target=run_recv_timeout_dead_peer,
+        args=("alice", addresses, transport, q),
+    )
+    alice.start()
+    try:
+        kind, elapsed = q.get(timeout=90)
+        assert kind == "TimeoutError", kind
+        # Fired by the store's expire loop near the 2s deadline, not by
+        # the 60s result() backstop.
+        assert elapsed < 30, elapsed
+        alice.join(timeout=60)
+        assert alice.exitcode == 0, alice.exitcode
+    finally:
+        if alice.is_alive():
+            alice.terminate()
+            alice.join(timeout=30)
+
+
 def run_victim(party, addresses, q):
     fed.init(
         addresses=addresses,
