@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos clean
+.PHONY: native test lint chaos latency clean
 
 native:
 	python setup.py build_ext --inplace
@@ -23,6 +23,14 @@ lint:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest \
 	  tests/test_resilience.py tests/test_failure_paths.py -q
+
+# Latency gate: the many-tiny-tasks micro-bench must stay under
+# FEDTPU_TINY_BUDGET_MS per task (default 1.0) or this exits 1 — a change
+# that re-adds a thread hop or pickle round to the small-message fast
+# path fails loudly here. Mirrors the `latency` job in
+# .github/workflows/tests.yml.
+latency:
+	JAX_PLATFORMS=cpu python tools/latency_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -76,6 +76,31 @@ def _progress(party: str, phase: str) -> None:
         pass  # diagnostics must never fail the measurement
 
 
+def _party_entry(target, party, *rest):
+    """Spawn trampoline: arm a SIGUSR1 all-thread stack dump into the
+    progress dir before the party body runs, so the parent's watchdog
+    can capture WHERE a hung party is stuck — not just the last phase
+    mark (BENCH_r05's "bench party hung" had no stack to go on)."""
+    d = os.environ.get(_PROGRESS_DIR_VAR)
+    if d:
+        try:
+            import faulthandler
+            import signal
+
+            # Keep the file object referenced: faulthandler holds only
+            # the fd, and a collected file object would close it.
+            _party_entry._stacks_file = open(
+                os.path.join(d, f"{party}.stacks"), "w"
+            )
+            faulthandler.register(
+                signal.SIGUSR1, file=_party_entry._stacks_file,
+                all_threads=True,
+            )
+        except (OSError, ValueError, AttributeError):
+            pass  # diagnostics must never fail the measurement
+    target(party, *rest)
+
+
 def _party_main(party, addresses, transport, result_path, device_dma=False,
                 pair_ceiling=False):
     import numpy as np
@@ -401,6 +426,100 @@ def _try_tpu_lanes() -> dict:
     return out
 
 
+def _paired_baseline_party(party, addresses, transport, result_path,
+                           port_plan, pairs):
+    """Paired vs_baseline windows: for each pair k, a native-lane window
+    and a reference-parity gRPC window run back-to-back in the SAME two
+    party processes (fresh fed job per window on preallocated ports).
+    The headline vs_baseline is the median of per-pair ratios, so both
+    sides of every ratio share the host regime they were measured in —
+    the unpaired ratio compares windows minutes apart, and loopback
+    throughput on this VM class swings 2-3x on a seconds timescale.
+    The outer ``addresses``/``transport`` of the harness are unused:
+    every window inits its own job from ``port_plan``."""
+    import numpy as np
+
+    import rayfed_tpu as fed
+
+    n_elem = PAYLOAD_MB * 1024 * 1024 // 4
+    gbps = {"tcp": [], "grpc": []}
+    for k in range(pairs):
+        for lane in ("tcp", "grpc"):
+            _progress(party, f"pair {k}/{pairs} lane {lane}")
+            fed.init(
+                addresses=port_plan[f"{k}-{lane}"],
+                party=party,
+                config={"cross_silo_comm": dict(_FAST_RETRY),
+                        "transport": lane},
+                job_name=f"bench-pair-{k}-{lane}",
+                logging_level="error",
+            )
+
+            @fed.remote
+            def produce(i):
+                return np.full((n_elem,), float(i), dtype=np.float32)
+
+            @fed.remote
+            def consume(x):
+                return float(x[0]) + float(x[-1])
+
+            @fed.remote
+            def barrier(*xs):
+                return len(xs)
+
+            # One discarded cycle (connection + allocator warmup), one
+            # timed cycle — identical treatment for both lanes, so the
+            # ratio cancels any residual cold-start cost.
+            for rep in (-1, 0):
+                base = 100.0 * rep + k
+                tensors = [
+                    produce.party("alice").remote(base + i)
+                    for i in range(ROUNDS)
+                ]
+                assert fed.get(
+                    barrier.party("alice").remote(*tensors)
+                ) == ROUNDS
+                t0 = time.perf_counter()
+                outs = [consume.party("bob").remote(t) for t in tensors]
+                checks = fed.get(outs)
+                dt = time.perf_counter() - t0
+                assert checks == [2.0 * (base + i) for i in range(ROUNDS)]
+                if rep >= 0:
+                    gbps[lane].append(ROUNDS * PAYLOAD_MB / 1024 / dt)
+            _progress(party, f"pair {k} lane {lane} done; shutting down")
+            fed.shutdown()
+    if party == "bob":
+        with open(result_path, "w") as f:
+            json.dump(gbps, f)
+
+
+def _run_paired_baseline() -> dict:
+    """Run the paired vs_baseline stage (see _paired_baseline_party).
+    Raises on failure — the caller treats this stage as best-effort and
+    falls back to the unpaired ratio."""
+    import statistics
+
+    pairs = int(os.environ.get("FEDTPU_BENCH_PAIRS", 3))
+    port_plan = {}
+    for k in range(pairs):
+        for lane in ("tcp", "grpc"):
+            p1, p2 = _free_ports(2)
+            port_plan[f"{k}-{lane}"] = {
+                "alice": f"127.0.0.1:{p1}",
+                "bob": f"127.0.0.1:{p2}",
+            }
+    res = _run_two_party(
+        _paired_baseline_party, "tcp", (port_plan, pairs), timeout_s=600
+    )
+    ratios = [t / g for t, g in zip(res["tcp"], res["grpc"]) if g > 0]
+    if not ratios:
+        raise RuntimeError("no paired windows completed")
+    return {
+        "vs_baseline": round(statistics.median(ratios), 3),
+        "vs_baseline_pairs": [round(r, 3) for r in ratios],
+    }
+
+
 def _tiny_party(party, addresses, transport, result_path, rounds):
     import rayfed_tpu as fed
 
@@ -516,8 +635,9 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
         result_path = os.path.join(tmp, "result.json")
         procs = [
             mp.Process(
-                target=target,
-                args=(party, addresses, transport, result_path) + extra_args,
+                target=_party_entry,
+                args=(target, party, addresses, transport, result_path)
+                + extra_args,
             )
             for party in parties
         ]
@@ -533,19 +653,46 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
         for p in procs:
             p.join(timeout=timeout_s)
         hung = [p for p in procs if p.is_alive()]
+        if hung:
+            # Ask each hung party for an all-thread stack dump BEFORE the
+            # kill (a terminated process can't report anything itself);
+            # _party_entry armed faulthandler on SIGUSR1 at spawn.
+            import signal
+
+            usr1 = getattr(signal, "SIGUSR1", None)
+            if usr1 is not None:
+                for p in hung:
+                    try:
+                        os.kill(p.pid, usr1)
+                    except OSError:
+                        pass
+                time.sleep(2.0)  # let faulthandler finish writing
         for p in hung:
             p.terminate()
             p.join(timeout=30)
         if hung:
             marks = {}
+            stacks = {}
             for party in parties:
                 try:
                     with open(os.path.join(tmp, f"{party}.progress")) as f:
                         marks[party] = f.read().strip() or "no mark"
                 except OSError:
                     marks[party] = "no mark"
+                try:
+                    with open(os.path.join(tmp, f"{party}.stacks")) as f:
+                        s = f.read().strip()
+                    if s:
+                        stacks[party] = s[-4000:]
+                except OSError:
+                    pass
+            detail = "".join(
+                f"\n--- {party} stacks at kill ---\n{s}"
+                for party, s in stacks.items()
+            )
             raise RuntimeError(
                 f"bench party hung; terminated (last phase marks: {marks})"
+                + detail
             )
         for p in procs:
             if p.exitcode != 0:
@@ -556,15 +703,17 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
 
 def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
                  cpu_force=False, parties=("alice", "bob"), timeout_s=300,
-                 digits=2) -> dict:
+                 digits=2, extra_fields=None) -> dict:
     """Run one two-to-N-party workload per (transport, result-key) pair.
 
     ``cpu_force`` wraps the spawned parties in :func:`_cpu_forced` —
     required whenever the workload jits (two processes cannot share the
     driver's single chip; a wedged accelerator tunnel must not hang the
-    children). Best-effort: on failure the keys gathered so far are kept
-    and the rest are skipped with a stderr note — the headline JSON line
-    always prints."""
+    children). ``extra_fields`` maps additional result fields to output
+    keys (recorded when present; single-key stages only — the output key
+    does not vary by transport). Best-effort: on failure the keys
+    gathered so far are kept and the rest are skipped with a stderr
+    note — the headline JSON line always prints."""
     out = {}
     try:
         with _cpu_forced() if cpu_force else contextlib.nullcontext():
@@ -575,6 +724,12 @@ def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
                     timeout_s=timeout_s, parties=parties,
                 )
                 out[key] = round(res[res_field], digits)
+                for rf, out_key in (extra_fields or {}).items():
+                    v = res.get(rf)
+                    if isinstance(v, list):
+                        out[out_key] = [round(x, digits) for x in v]
+                    elif isinstance(v, (int, float)):
+                        out[out_key] = round(v, digits)
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"{party_fn.__name__} bench skipped: {e!r}", file=sys.stderr)
     return out
@@ -620,14 +775,28 @@ def _hier4_party(party, addresses, transport, result_path, rounds):
     _progress(party, "init done; warmup round")
     one_round(-1)  # warmup (connections, executor)
     _progress(party, "timed rounds")
-    t0 = time.perf_counter()
+    dts = []
     for r in range(rounds):
+        t0 = time.perf_counter()
         one_round(r)
-    dt = time.perf_counter() - t0
+        dts.append((time.perf_counter() - t0) * 1000)
     _progress(party, "rounds done; shutting down")
     if party == "alice":
+        import statistics
+
+        # Mean keeps continuity with earlier rounds' round_ms; the
+        # median and [min, max] spread qualify how noisy the stage was
+        # (4 parties on a shared VM — a single steal burst can double
+        # the mean without touching the median).
         with open(result_path, "w") as f:
-            json.dump({"round_ms": dt / rounds * 1000}, f)
+            json.dump(
+                {
+                    "round_ms": sum(dts) / len(dts),
+                    "round_ms_median": statistics.median(dts),
+                    "round_ms_spread": [min(dts), max(dts)],
+                },
+                f,
+            )
     fed.shutdown()
 
 
@@ -864,7 +1033,7 @@ def main() -> None:
         "metric": "2-party cross-party push throughput, 100MB float32 tensors",
         "value": round(native["max"], 3),
         "unit": "GB/s",
-        "vs_baseline": round(native["max"] / baseline["max"], 3),
+        "vs_baseline_unpaired": round(native["max"] / baseline["max"], 3),
         "value_median": round(native["median"], 3),
         "baseline_grpc_cloudpickle_gbps": round(baseline["max"], 3),
         "rounds": ROUNDS,
@@ -878,6 +1047,15 @@ def main() -> None:
         result["pct_of_ceiling"] = round(
             100.0 * native["paired_ratio_median"], 1
         )
+    # Paired vs_baseline: per-pair tcp/grpc window ratios measured
+    # seconds apart in the same processes. Best-effort — on failure the
+    # unpaired ratio (max-of-run over max-of-run, windows minutes apart)
+    # keeps the key populated for continuity.
+    try:
+        result.update(_run_paired_baseline())
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"paired baseline skipped: {e!r}", file=sys.stderr)
+    result.setdefault("vs_baseline", result["vs_baseline_unpaired"])
     result.update(tpu_lanes)
     if mfu:
         result.update(mfu)
@@ -897,6 +1075,10 @@ def main() -> None:
     result.update(_bench_stage(
         _hier4_party, "round_ms", "FEDTPU_BENCH_HIER4_ROUNDS", 20,
         [("tcp", "hier4_round_ms")], cpu_force=True, parties=_HIER4,
+        extra_fields={
+            "round_ms_median": "hier4_round_ms_median",
+            "round_ms_spread": "hier4_round_ms_spread",
+        },
     ))
     result.update(_bench_stage(
         _cnn_party, "round_ms", "FEDTPU_BENCH_CNN_ROUNDS", 5,

@@ -151,7 +151,10 @@ static void pool_put(Block b) {
         std::lock_guard<std::mutex> g(pool_mu);
         pool_blocks.push_back(b);
         pool_free_bytes += b.size;
-        while (pool_free_bytes > pool_cap && pool_blocks.size() > 1) {
+        /* Evict oldest-first until the cap holds — including down to an
+         * empty pool, so a single block larger than FEDTPU_RECV_POOL_MB
+         * is freed instead of retained forever. */
+        while (pool_free_bytes > pool_cap && !pool_blocks.empty()) {
             evicted.push_back(pool_blocks.front());
             pool_free_bytes -= pool_blocks.front().size;
             pool_blocks.erase(pool_blocks.begin());
@@ -452,6 +455,134 @@ static PyObject *fastwire_recv_prefix_header(PyObject *self, PyObject *args) {
 }
 
 /* ------------------------------------------------------------------ */
+/* recv_frame_small                                                    */
+/* ------------------------------------------------------------------ */
+
+/* recv_frame_small(fd, timeout_ms, magic4, version, max_header,
+ *                  max_payload, small_max)
+ *     -> (ftype, plen, header_bytes, payload | None)
+ *
+ * The latency-path sibling of recv_prefix_header: when the frame's
+ * payload fits within small_max, the prefix, header AND payload are all
+ * received inside ONE GIL-released window — a small frame costs a single
+ * GIL round-trip instead of three (prefix+header, sizes, scatter).
+ * Validation order and error codes match recv_prefix_header exactly.
+ * For plen > small_max the payload slot is None and the caller falls
+ * through to the scatter/pooled machinery unchanged. The payload comes
+ * back as a writable bytearray (consumers build numpy views on it). */
+static PyObject *fastwire_recv_frame_small(PyObject *self, PyObject *args) {
+    int fd;
+    long timeout_ms;
+    const char *magic;
+    Py_ssize_t magic_len;
+    int version;
+    unsigned long long max_header, max_payload, small_max;
+    if (!PyArg_ParseTuple(args, "ily#iKKK", &fd, &timeout_ms, &magic,
+                          &magic_len, &version, &max_header, &max_payload,
+                          &small_max))
+        return NULL;
+    if (magic_len != 4) {
+        PyErr_SetString(PyExc_ValueError, "magic must be 4 bytes");
+        return NULL;
+    }
+
+    unsigned char prefix[18]; /* 4 + 1 + 1 + 4 + 8 */
+    char *hdr = NULL;
+    char *pay = NULL;
+    int err = 0;            /* recv_all code */
+    int bad = 0;            /* 1 magic, 2 version, 3 hlen, 4 plen, 5 oom */
+    unsigned int hlen = 0;
+    unsigned long long plen = 0;
+    unsigned int ftype = 0;
+    unsigned int ver = 0;
+    int inlined = 0;        /* payload received in this window */
+
+    Py_BEGIN_ALLOW_THREADS;
+    err = recv_all(fd, (char *)prefix, 18, timeout_ms);
+    if (err == 0) {
+        ver = prefix[4];
+        ftype = prefix[5];
+        hlen = ((unsigned int)prefix[6] << 24) |
+               ((unsigned int)prefix[7] << 16) |
+               ((unsigned int)prefix[8] << 8) | (unsigned int)prefix[9];
+        plen = 0;
+        for (int i = 0; i < 8; i++)
+            plen = (plen << 8) | (unsigned long long)prefix[10 + i];
+        if (memcmp(prefix, magic, 4) != 0) {
+            bad = 1;
+        } else if (ver != (unsigned int)version) {
+            bad = 2;
+        } else if ((unsigned long long)hlen > max_header) {
+            bad = 3;
+        } else if (plen > max_payload) {
+            bad = 4;
+        } else {
+            hdr = (char *)malloc(hlen ? hlen : 1);
+            if (hdr == NULL) {
+                bad = 5;
+            } else {
+                err = recv_all(fd, hdr, hlen, timeout_ms);
+                if (err == 0 && plen <= small_max) {
+                    inlined = 1;
+                    pay = (char *)malloc(plen ? (size_t)plen : 1);
+                    if (pay == NULL) {
+                        bad = 5;
+                    } else {
+                        err = recv_all(fd, pay, (size_t)plen, timeout_ms);
+                    }
+                }
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS;
+
+    if (err != 0) {
+        free(hdr);
+        free(pay);
+        return raise_io(err, "recv");
+    }
+    switch (bad) {
+    case 1:
+        PyErr_Format(PyExc_ValueError, "bad magic %.4s", (char *)prefix);
+        return NULL;
+    case 2:
+        PyErr_Format(PyExc_ValueError, "unsupported wire version %u", ver);
+        return NULL;
+    case 3:
+        PyErr_Format(PyExc_ValueError, "header length %u exceeds cap", hlen);
+        return NULL;
+    case 4:
+        PyErr_Format(PyExc_ValueError,
+                     "payload length %llu exceeds cap %llu", plen,
+                     max_payload);
+        return NULL;
+    case 5:
+        free(hdr);
+        free(pay);
+        return PyErr_NoMemory();
+    }
+    PyObject *hbytes = PyBytes_FromStringAndSize(hdr, (Py_ssize_t)hlen);
+    free(hdr);
+    if (hbytes == NULL) {
+        free(pay);
+        return NULL;
+    }
+    PyObject *pobj;
+    if (inlined) {
+        pobj = PyByteArray_FromStringAndSize(pay, (Py_ssize_t)plen);
+        free(pay);
+        if (pobj == NULL) {
+            Py_DECREF(hbytes);
+            return NULL;
+        }
+    } else {
+        pobj = Py_None;
+        Py_INCREF(pobj);
+    }
+    return Py_BuildValue("IKNN", ftype, plen, hbytes, pobj);
+}
+
+/* ------------------------------------------------------------------ */
 /* recv_scatter                                                        */
 /* ------------------------------------------------------------------ */
 
@@ -565,6 +696,10 @@ static PyMethodDef fastwire_methods[] = {
     {"recv_prefix_header", fastwire_recv_prefix_header, METH_VARARGS,
      "recv_prefix_header(fd, timeout_ms, magic, version, max_header, "
      "max_payload) -> (ftype, plen, header_bytes)."},
+    {"recv_frame_small", fastwire_recv_frame_small, METH_VARARGS,
+     "recv_frame_small(fd, timeout_ms, magic, version, max_header, "
+     "max_payload, small_max) -> (ftype, plen, header_bytes, "
+     "payload|None): whole small frame in one GIL-released window."},
     {"recv_scatter", fastwire_recv_scatter, METH_VARARGS,
      "recv_scatter(fd, timeout_ms, sizes) -> list of pooled buffers."},
     {"pool_trim", fastwire_pool_trim, METH_NOARGS,

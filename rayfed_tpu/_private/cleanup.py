@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import os
 import signal
+import threading
 from typing import Callable, Optional
 
 from rayfed_tpu._private.message_queue import MessageQueueManager
@@ -52,6 +53,10 @@ class CleanupManager:
         self._current_party = current_party
         self._acquire_shutdown_flag = acquire_shutdown_flag
         self._last_sending_error: Optional[Exception] = None
+        # Data sends not yet discharged (future -> queued message). Happy
+        # paths never touch the drain thread; see push_to_sending.
+        self._inflight: dict = {}
+        self._inflight_lock = threading.Lock()
         self._exit_on_sending_failure = False
         self._expose_error_trace = False
         # Fast-fail drain (entered by stop(wait_for_sending=False)): pending
@@ -72,6 +77,21 @@ class CleanupManager:
     def stop(self, wait_for_sending: bool = False) -> None:
         if not wait_for_sending:
             self._fast_fail = True
+        # In-flight data sends first: wait for each to resolve (bounded in
+        # fast-fail mode) and discharge it — failures land in the data
+        # queue as envelope jobs before the stop symbol does.
+        timeout_each = 2.0 if self._fast_fail else None
+        while True:
+            with self._inflight_lock:
+                item = next(iter(self._inflight.items()), None)
+            if item is None:
+                break
+            f, msg = item
+            try:
+                f.result(timeout=timeout_each)
+            except BaseException:  # noqa: BLE001 - discharge decides
+                pass
+            self._discharge_data_send(f, msg)
         # Data queue first: its failure handling may enqueue error sends
         # (same ordering constraint as ref cleanup.py:71-76). Both queues
         # always drain gracefully — in fast-fail mode each item's wait is
@@ -93,7 +113,33 @@ class CleanupManager:
         msg = (send_future, dest_party, upstream_seq_id, downstream_seq_id)
         if is_error:
             self._sending_error_q.append(msg)
+            return
+        # Successful sends must not wake the drain thread (one context
+        # switch per ack adds up on small-message rounds): the discharge
+        # runs as a done-callback on whichever thread resolves the ack,
+        # and only *failed* sends become drain-queue jobs (for error
+        # enveloping). stop() sweeps whatever is still in flight.
+        with self._inflight_lock:
+            self._inflight[send_future] = msg
+        send_future.add_done_callback(
+            lambda f, _msg=msg: self._discharge_data_send(f, _msg)
+        )
+
+    def _discharge_data_send(self, f, msg) -> None:
+        """At-most-once per send (callback and stop() both call this; the
+        inflight map arbitrates): drop a successful send, queue an
+        error-envelope job for a failed or still-pending one."""
+        with self._inflight_lock:
+            if self._inflight.pop(f, None) is None:
+                return
+        if f.done() and not f.cancelled():
+            try:
+                failed = f.exception() is not None
+            except BaseException:  # noqa: BLE001
+                failed = True
         else:
+            failed = True  # cancelled, or stop() gave up waiting
+        if failed:
             self._sending_data_q.append(msg)
 
     def get_last_sending_error(self) -> Optional[Exception]:

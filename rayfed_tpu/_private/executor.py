@@ -46,13 +46,115 @@ from rayfed_tpu import tree_util
 
 
 def _resolve(obj: Any) -> Any:
-    """Replace every Future leaf in a pytree with its result (blocking)."""
+    """Replace every Future leaf in a pytree with its result (blocking;
+    steals the producing task inline when it has not started yet)."""
     def leaf(x: Any) -> Any:
         if isinstance(x, Future):
+            if not x.done():
+                steal(x)
             return x.result()
         return x
 
     return tree_util.tree_map(leaf, obj)
+
+
+def _deps_ready(obj: Any) -> bool:
+    """True when no Future leaf in the pytree is still pending (failed
+    futures count as ready — _resolve will surface their exception)."""
+    ready = True
+
+    def leaf(x: Any) -> Any:
+        nonlocal ready
+        if ready and isinstance(x, Future) and not x.done():
+            ready = False
+        return x
+
+    tree_util.tree_map(leaf, obj)
+    return ready
+
+
+def try_resolved(obj: Any) -> "tuple[bool, Any]":
+    """Non-blocking companion to :func:`_resolve` for the send fast path:
+    (True, value) when ``obj`` is a plain value or an already-successful
+    Future — the caller may proceed inline without a pool hop — else
+    (False, None), meaning the value still needs the blocking dataflow
+    path (pending, or failed: the worker path owns error enveloping)."""
+    if isinstance(obj, Future):
+        if obj.done() and obj.exception() is None:
+            return True, obj.result()
+        return False, None
+    return True, obj
+
+
+class _StealableTask:
+    """A pool task a *blocked consumer* may claim and run on its own
+    thread. On a busy (or single-core) host the pool-worker wake-up is a
+    full context switch on the critical path; a consumer that is about to
+    block in ``Future.result`` runs the producer inline instead. The
+    claim flag makes pool worker and thief mutually exclusive — whoever
+    claims first runs, the other does nothing."""
+
+    __slots__ = ("fn", "args", "kwargs", "out", "num_returns",
+                 "_lock", "_claimed")
+
+    def __init__(self, fn, args, kwargs, out, num_returns):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.out = out
+        self.num_returns = num_returns
+        self._lock = threading.Lock()
+        self._claimed = False
+
+    def claim(self) -> bool:
+        with self._lock:
+            if self._claimed:
+                return False
+            self._claimed = True
+            return True
+
+    def run_if_unclaimed(self) -> None:
+        if self.claim():
+            self._execute()
+
+    def _execute(self) -> None:
+        _run_task(self.fn, self.args, self.kwargs, self.out,
+                  self.num_returns)
+        # Drop payload refs promptly: the out-futures keep this shell
+        # alive via their steal attribute until they are collected.
+        self.fn = self.args = self.kwargs = self.out = None
+
+
+_steal_depth = threading.local()
+# Each inline steal nests _run_task/_resolve frames on the thief's stack;
+# cap the nesting so a long dependency chain blocks (pool workers make
+# progress independently) instead of hitting the recursion limit.
+_STEAL_DEPTH_MAX = 20
+
+
+def steal(fut: Future) -> None:
+    """If ``fut`` belongs to a queued-but-unstarted pool task, run that
+    task on the calling thread. No-op for lane (actor) tasks, transport
+    futures, started/claimed tasks, or past the nesting cap."""
+    task = getattr(fut, "_fedtpu_steal", None)
+    if task is None:
+        return
+    depth = getattr(_steal_depth, "v", 0)
+    if depth >= _STEAL_DEPTH_MAX or not task.claim():
+        return
+    _steal_depth.v = depth + 1
+    try:
+        task._execute()
+    finally:
+        _steal_depth.v = depth
+
+
+def result_stealing(fut: Future, timeout: Optional[float] = None) -> Any:
+    """``fut.result(timeout)`` preceded by an inline steal attempt — the
+    entry point for API-level consumers (``fed.get``)."""
+    if not fut.done():
+        steal(fut)
+    return fut.result(timeout)
 
 
 def _run_task(
@@ -168,10 +270,24 @@ class LocalExecutor:
 
             if not lane.submit_thunk(thunk):
                 fail_all(FedActorKilledError("actor was killed"))
+        elif _deps_ready(list(args)) and _deps_ready(kwargs or {}):
+            # Eager inline execution: every dependency is already
+            # resolved, so the task has nothing to block on — running it
+            # on the caller's thread skips the pool-dispatch wake-up AND
+            # the consumer's wait wake-up (the future resolves before
+            # submit returns). This cannot deadlock the driver: every
+            # future in this system is created at submission time (task,
+            # actor call, or transport recv), so anything a task could
+            # wait on internally is already in flight and resolves
+            # without the caller's help. The latency-critical chains
+            # (small federated rounds) are exactly the ones whose tiny
+            # tasks land here.
+            _run_task(fn, args, kwargs, out, num_returns)
         else:
-            self._pool.submit(
-                lambda: _run_task(fn, args, kwargs, out, num_returns)
-            )
+            task = _StealableTask(fn, args, kwargs, out, num_returns)
+            for f in out if isinstance(out, list) else [out]:
+                f._fedtpu_steal = task
+            self._pool.submit(task.run_if_unclaimed)
         return out
 
     def new_lane(self, name: str = "fedtpu-actor-lane") -> SerialLane:

@@ -370,12 +370,13 @@ def wire_dtype_name(knob: Optional[str]) -> Optional[str]:
 
 def try_encode_tree(
     data: Any, wire_dtype: Optional[str] = None
-) -> Optional[Tuple[dict, List[Any]]]:
+) -> Optional[Tuple[bytes, List[Any]]]:
     """Attempt the zero-pickle encoding.
 
-    Returns (meta, buffers) or None if the payload needs pickling. ``meta``
-    is msgpack-encodable; ``buffers`` is a list of byte-like objects to be
-    written after the header (no concatenation of large arrays).
+    Returns (meta_bytes, buffers) or None if the payload needs pickling.
+    ``meta_bytes`` is the msgpack-packed meta dict; ``buffers`` is a list of
+    byte-like objects to be written after the header (no concatenation of
+    large arrays).
 
     ``wire_dtype`` (canonical name from :func:`wire_dtype_name`) downcasts
     wide-float dense array leaves on the wire — LOSSY, opt-in; each leaf's
@@ -439,10 +440,10 @@ def try_encode_tree(
             return None
     meta = {"spec": wire_spec, "leaves": descs}
     try:
-        msgpack.packb(meta, use_bin_type=True)
+        meta_bytes = msgpack.packb(meta, use_bin_type=True)
     except Exception:  # noqa: BLE001 - any unpackable meta -> pickle lane
         return None
-    return meta, buffers
+    return meta_bytes, buffers
 
 
 def shard_view(desc: dict, shard: dict, payload) -> np.ndarray:
@@ -561,21 +562,137 @@ def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:
     return tree_util.tree_unflatten(leaves, spec)
 
 
+# ---------------------------------------------------------------------------
+# Small-message compact lane ("mp"): scalars and plain containers of
+# scalars cross as a single msgpack blob — no tree walk, no per-leaf meta,
+# no pickle on either end. Type fidelity is strict: anything msgpack would
+# round-trip as a *different* Python type (tuples, namedtuples, subclasses,
+# numpy scalars) falls through to the tree/pickle lanes.
+# ---------------------------------------------------------------------------
+
+_MP_EXACT_SCALARS = frozenset(
+    (bool, int, float, str, bytes, type(None))
+)
+_MP_MAX_DEPTH = 32
+
+
+def _msgpack_clean(x: Any, depth: int = 0) -> bool:
+    """True iff ``x`` round-trips through msgpack with exact types: only
+    the exact builtin scalar types (int within 64 bits), lists, and dicts
+    with str/int keys. Subclasses and tuples are rejected — msgpack would
+    return them as base types / lists."""
+    if depth > _MP_MAX_DEPTH:
+        return False
+    t = type(x)
+    if t in _MP_EXACT_SCALARS:
+        return t is not int or -(2**63) <= x < 2**64
+    if t is list:
+        return all(_msgpack_clean(v, depth + 1) for v in x)
+    if t is dict:
+        return all(
+            type(k) in (str, int) and _msgpack_clean(v, depth + 1)
+            for k, v in x.items()
+        )
+    return False
+
+
+def try_encode_compact(data: Any, max_bytes: int) -> Optional[bytes]:
+    """Encode ``data`` as one msgpack blob when it is msgpack-clean and the
+    blob fits in ``max_bytes``; None otherwise (caller falls through to the
+    tree/pickle lanes)."""
+    if max_bytes <= 0 or not _msgpack_clean(data):
+        return None
+    try:
+        blob = msgpack.packb(data, use_bin_type=True, strict_types=True)
+    except Exception:  # noqa: BLE001 - anything unpackable -> normal lanes
+        return None
+    if len(blob) > max_bytes:
+        return None
+    return blob
+
+
+def decode_compact(payload) -> Any:
+    return msgpack.unpackb(
+        payload_bytes(payload), raw=False, strict_map_key=False
+    )
+
+
+def quick_payload_bound(data: Any, limit: int) -> bool:
+    """Conservative constant-ish-time probe: True only when the encoded
+    payload for ``data`` is guaranteed to fit within ``limit`` bytes.
+    False means "don't know / too big" — callers fall back to the normal
+    queued path, so under-estimation is the only correctness hazard and
+    every unknown leaf type declines. Used by the send fast path to decide
+    *before* encoding whether a payload may ride the inline small lane."""
+    if limit <= 0:
+        return False
+    budget = _quick_bound(data, 0)
+    return budget is not None and budget <= limit
+
+
+_QUICK_ITEM_CAP = 256
+
+
+def _quick_bound(x: Any, depth: int) -> Optional[int]:
+    if depth > _MP_MAX_DEPTH:
+        return None
+    t = type(x)
+    if t in _MP_EXACT_SCALARS:
+        if t is str:
+            return 8 + 4 * len(x)  # worst-case UTF-8 expansion
+        if t is bytes:
+            return 8 + len(x)
+        return 16
+    if t in (list, tuple):
+        if len(x) > _QUICK_ITEM_CAP:
+            return None
+        total = 8
+        for v in x:
+            b = _quick_bound(v, depth + 1)
+            if b is None:
+                return None
+            total += b
+        return total
+    if t is dict:
+        if len(x) > _QUICK_ITEM_CAP:
+            return None
+        total = 8
+        for k, v in x.items():
+            kb = _quick_bound(k, depth + 1)
+            vb = _quick_bound(v, depth + 1)
+            if kb is None or vb is None:
+                return None
+            total += kb + vb
+        return total
+    nbytes = getattr(x, "nbytes", None)
+    if isinstance(nbytes, int):
+        # Array-like leaf: raw bytes + generous per-leaf meta margin.
+        return nbytes + 256
+    return None
+
+
 def encode_payload(
-    data: Any, wire_dtype: Optional[str] = None
+    data: Any,
+    wire_dtype: Optional[str] = None,
+    small_threshold: Optional[int] = None,
 ) -> Tuple[str, bytes, List[Any]]:
     """Encode any payload for the wire.
 
-    Returns (kind, meta_bytes, buffers): kind in {"tree", "pickle"};
-    meta_bytes is msgpack (tree) or empty (pickle); buffers are written
+    Returns (kind, meta_bytes, buffers): kind in {"mp", "tree", "pickle"};
+    meta_bytes is msgpack (tree) or empty (mp/pickle); buffers are written
     after the frame header in order. ``wire_dtype`` — see
     :func:`try_encode_tree` (tree lane only; the pickle lane ships
-    objects verbatim).
+    objects verbatim). ``small_threshold`` (> 0) enables the compact
+    ``mp`` lane for msgpack-clean payloads whose blob fits within it.
     """
+    if small_threshold:
+        blob = try_encode_compact(data, small_threshold)
+        if blob is not None:
+            return "mp", b"", [blob]
     enc = try_encode_tree(data, wire_dtype=wire_dtype)
     if enc is not None:
-        meta, buffers = enc
-        return "tree", msgpack.packb(meta, use_bin_type=True), buffers
+        meta_bytes, buffers = enc
+        return "tree", meta_bytes, buffers
     return "pickle", b"", [dumps(data)]
 
 
@@ -590,6 +707,9 @@ def decode_payload(
         return decode_tree(
             msgpack.unpackb(meta_bytes, raw=False), payload, sharded_fn
         )
+    if kind == "mp":
+        # Pure msgpack — no unpickling, so no whitelist concerns.
+        return decode_compact(payload)
     if kind == "pickle":
         return restricted_loads(payload_bytes(payload), allowed_list)
     raise ValueError(f"unknown payload kind: {kind}")

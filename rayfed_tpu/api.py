@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import rayfed_tpu._private.constants as constants
 import rayfed_tpu.config as fed_config
 import rayfed_tpu.utils as fed_utils
+from rayfed_tpu._private import executor
 from rayfed_tpu._private import kv as internal_kv
 from rayfed_tpu._private.call_holder import FedCallHolder
 from rayfed_tpu._private.fed_actor import FedActorHandle
@@ -613,8 +614,10 @@ def get(
 
     try:
         if timeout is None and on_missing == "raise":
-            # Legacy fast path, bit-for-bit: block forever per future.
-            values = [f.result() for f in futures]
+            # Legacy fast path, bit-for-bit: block forever per future
+            # (stealing a not-yet-started producer inline instead of
+            # waiting for a pool worker to wake).
+            values = [executor.result_stealing(f) for f in futures]
         else:
             values, missing = resolve_with_policy(
                 futures, timeout, on_missing, default

@@ -186,6 +186,16 @@ class CrossSiloMessageConfig:
     # peer-reachable interface, not loopback.
     device_dma: bool = False
     dma_listen_addr: str = "127.0.0.1:0"
+    # Small-message fast path: payloads at or below this many bytes skip
+    # the per-message fixed costs that dominate latency-bound rounds —
+    # they ride the compact msgpack encoding (no tree walk for plain
+    # scalars/containers), are never compressed or chunked, are sent
+    # inline (and coalesced with other queued small frames into one
+    # syscall) instead of hopping through the sender worker queue, and
+    # are decoded inline on the receiver instead of on the decode pool.
+    # 0 disables the fast path entirely. Large-payload behavior is
+    # unchanged at any setting.
+    small_message_threshold: int = 64 * 1024
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False

@@ -295,7 +295,6 @@ def send(
     fut = _sender_proxy.send(
         dest_party, data, upstream_seq_id, downstream_seq_id, is_error=is_error
     )
-    ctx = get_global_context()
     if ctx is not None:
         ctx.get_cleanup_manager().push_to_sending(
             fut, dest_party, upstream_seq_id, downstream_seq_id, is_error

@@ -105,6 +105,12 @@ class RendezvousStore:
         self._pool = ThreadPoolExecutor(
             max_workers=decode_workers, thread_name_prefix="fedtpu-recv-decode"
         )
+        # Payloads at/below this decode inline on the offering/taking
+        # thread instead of hopping to the pool: for small frames the
+        # cross-thread handoff costs more than the decode itself, and the
+        # common case (consumer already parked in take()) resolves the
+        # waiter one hop sooner.
+        self._inline_decode_max = 64 * 1024
         self._stats = {"receive_op_count": 0}
         # Readiness-ping bookkeeping (barrier mutuality): which peers
         # have pinged this receiver, by the header's src when the lane
@@ -155,7 +161,10 @@ class RendezvousStore:
 
     def offer(self, header: Dict, payload) -> Tuple[int, str]:
         """Accept one DATA frame; returns (code, message) for the response.
-        Must not block on decode — decoding runs on the worker pool."""
+        Large payloads never block the transport thread on decode —
+        decoding runs on the worker pool; small payloads (within
+        ``_inline_decode_max``) decode inline, where the handoff would
+        cost more than the decode."""
         job = header.get("job")
         if job != self._job_name:
             # Job-name isolation (ref grpc_proxy.py:311-320).
@@ -223,8 +232,17 @@ class RendezvousStore:
                 time.perf_counter(),
             )
         if waiter is not None:
-            self._pool.submit(self._decode_into, header, payload, waiter)
+            self._deliver(header, payload, waiter, nbytes)
         return CODE_OK, "ok"
+
+    def _deliver(self, header: Dict, payload, out: Future,
+                 nbytes: Optional[int] = None) -> None:
+        if nbytes is None:
+            nbytes = serialization.payload_nbytes(payload)
+        if nbytes <= self._inline_decode_max:
+            self._decode_into(header, payload, out)
+        else:
+            self._pool.submit(self._decode_into, header, payload, out)
 
     def _mark_consumed(self, key) -> None:
         # Caller holds self._lock.
@@ -250,7 +268,7 @@ class RendezvousStore:
                         time.monotonic() + self._recv_timeout_s
                     )
                 return out
-        self._pool.submit(self._decode_into, header, payload, out)
+        self._deliver(header, payload, out)
         return out
 
     def _decode_into(self, header: Dict, payload, out: Future) -> None:

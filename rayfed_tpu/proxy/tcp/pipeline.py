@@ -51,16 +51,27 @@ logger = logging.getLogger(__name__)
 WINDOW = 8
 
 
-class _Inflight:
-    __slots__ = ("out", "header", "buffers", "attempts", "sent_at", "fseq")
+# Max frames drained into one coalesced small-frame dispatch. Each batch
+# frame still occupies its own window slot, so the window semaphore keeps
+# bounding resend memory; the batch cap only bounds a single writev's
+# latency cost for the frames queued behind it.
+_BATCH_MAX = 16
 
-    def __init__(self, out: Future, header, buffers, fseq: int):
+
+class _Inflight:
+    __slots__ = (
+        "out", "header", "buffers", "attempts", "sent_at", "fseq", "nbytes"
+    )
+
+    def __init__(self, out: Future, header, buffers, fseq: int,
+                 nbytes: int = 0):
         self.out = out
         self.header = header
         self.buffers = buffers
         self.attempts = 0
         self.sent_at = 0.0
         self.fseq = fseq
+        self.nbytes = nbytes
 
 
 class PipelinedLane:
@@ -75,15 +86,24 @@ class PipelinedLane:
         ack_timeout_s: float,
         on_ack: Callable[[], None],
         window: int = WINDOW,
+        small_threshold: int = 0,
     ):
         self._dest = dest
         self._connect = connect
         self._max_attempts = max_attempts
         self._ack_timeout_s = ack_timeout_s
         self._on_ack = on_ack
+        # Frames at/below this payload size may be coalesced with other
+        # queued frames into one vectored write (0 disables batching).
+        self._small_threshold = small_threshold
         self._next_fseq = 0
+        self._submit_lock = threading.Lock()
         self._jobs: Queue = Queue()
         self._lock = threading.Lock()
+        # Serializes actual socket writes: the writer thread, resend path
+        # and the inline small-send fast path must never interleave the
+        # bytes of two frames on the wire.
+        self._send_mutex = threading.Lock()
         self._inflight: deque = deque()
         self._window = threading.Semaphore(max(1, window))
         self._sock: Optional[socket.socket] = None
@@ -100,13 +120,67 @@ class PipelinedLane:
         )
         self._writer.start()
 
-    def submit(self, out: Future, header, buffers) -> None:
+    def submit(self, out: Future, header, buffers, nbytes: int = 0) -> None:
         # Frames carry a per-lane sequence number which the receiver echoes
         # in its RESP; acks are matched by it, never by position — a late
         # ack for a timed-out/resent frame must not resolve its successor.
-        self._next_fseq += 1
-        header = dict(header, fseq=self._next_fseq)
-        self._jobs.put(_Inflight(out, header, buffers, self._next_fseq))
+        # fseq assignment is locked: the inline send fast path submits
+        # from arbitrary caller threads, not only the dest worker (frames
+        # may hit the wire out of fseq order, which is harmless — acks
+        # match by fseq, never by position).
+        with self._submit_lock:
+            self._next_fseq += 1
+            fseq = self._next_fseq
+        job = _Inflight(out, dict(header, fseq=fseq), buffers, fseq, nbytes)
+        if (
+            self._small_threshold > 0
+            and 0 < nbytes <= self._small_threshold
+            and self._try_inline_send(job)
+        ):
+            return
+        self._jobs.put(job)
+
+    def _try_inline_send(self, job: _Inflight) -> bool:
+        """Zero-hop dispatch: when the lane is idle — live connection,
+        free window slot, no queued backlog, write mutex uncontended —
+        write the frame on the CALLER's thread instead of waking the
+        writer. Every gate is non-blocking; any contention falls back to
+        the queue. An inline frame may overtake queued frames on the
+        wire, which is harmless: acks match by fseq and every (up, down)
+        edge is a unique rendezvous key. Returns True when the job was
+        dispatched (or handed to the break/resend machinery)."""
+        if not self._window.acquire(blocking=False):
+            return False
+        if not self._send_mutex.acquire(blocking=False):
+            self._window.release()
+            return False
+        try:
+            with self._lock:
+                sock = self._sock
+                ok = (
+                    sock is not None
+                    and not self._broken
+                    and not self._closed
+                    and self._jobs.empty()
+                )
+                if ok:
+                    job.attempts += 1
+                    job.sent_at = time.monotonic()
+                    self._inflight.append(job)
+            if not ok:
+                self._window.release()
+                return False
+            try:
+                sockio.send_frames(
+                    sock, [(wire.FTYPE_DATA, job.header, job.buffers)]
+                )
+            except (OSError, ConnectionError) as e:
+                # The job is tracked in _inflight: the break machinery
+                # owns it now (resend from _tick, or attempt-budget fail).
+                self._handle_break(e)
+            return True
+        finally:
+            self._send_mutex.release()
 
     def close(self) -> None:
         self._closed = True
@@ -124,49 +198,94 @@ class PipelinedLane:
             if job is None:
                 self._teardown(ConnectionError("sender stopped"))
                 return
-            # Window acquire must not park unconditionally: if the
-            # connection broke while the window is full, only _tick() can
-            # time out / resend the stuck frames.
+            # Head job's window slot first. The acquire must not park
+            # unconditionally: if the connection broke while the window
+            # is full, only _tick() can time out / resend stuck frames.
+            stopped = False
             while not self._window.acquire(timeout=0.2):
                 self._tick()
                 if self._closed:
-                    job.out.set_exception(ConnectionError("sender stopped"))
-                    self._teardown(ConnectionError("sender stopped"))
-                    return
-            if not self._dispatch(job):
+                    stopped = True
+                    break
+            if stopped:
+                err = ConnectionError("sender stopped")
+                job.out.set_exception(err)
+                self._teardown(err)
+                return
+            # Small-frame coalescing: when the head job is small, drain
+            # whatever else is already queued (up to _BATCH_MAX; a large
+            # job ends the batch) so the whole run goes out in ONE
+            # vectored write instead of one syscall per frame. Each extra
+            # frame must find a free window slot RIGHT NOW: blocking for
+            # one later would park waiting for the ack of a frame this
+            # very batch hasn't sent yet (deadlock when window < batch).
+            batch = [job]
+            close_after = False
+            if (
+                self._small_threshold > 0
+                and job.nbytes <= self._small_threshold
+            ):
+                while len(batch) < _BATCH_MAX:
+                    if not self._window.acquire(blocking=False):
+                        break
+                    try:
+                        nxt = self._jobs.get_nowait()
+                    except Empty:
+                        self._window.release()
+                        break
+                    if nxt is None:
+                        self._window.release()
+                        close_after = True
+                        break
+                    batch.append(nxt)
+                    if nxt.nbytes > self._small_threshold:
+                        break
+            if not self._dispatch(batch):
                 # Closed during a failed dispatch: drain every pending
                 # future so no consumer blocks forever.
                 self._teardown(ConnectionError("sender stopped"))
                 return
+            if close_after:
+                self._teardown(ConnectionError("sender stopped"))
+                return
 
-    def _dispatch(self, job: _Inflight) -> bool:
-        """Send one job (reconnecting/resending as needed). Returns False
-        only when the lane is closed."""
+    def _dispatch(self, jobs) -> bool:
+        """Send a batch of jobs (reconnecting/resending as needed) in one
+        vectored write. Returns False only when the lane is closed."""
         if self._closed:
-            # Closed before the first attempt: this job is in neither
-            # _inflight nor _jobs, so fail it here or nobody ever will.
-            self._window.release()
-            job.out.set_exception(ConnectionError("sender stopped"))
+            # Closed before the first attempt: these jobs are in neither
+            # _inflight nor _jobs, so fail them here or nobody ever will.
+            for job in jobs:
+                self._window.release()
+                job.out.set_exception(ConnectionError("sender stopped"))
             return False
         while not self._closed:
             try:
                 sock = self._ensure_conn()
             except Exception as e:  # noqa: BLE001 - connect budget exhausted
-                self._window.release()
-                job.out.set_exception(e)
+                for job in jobs:
+                    self._window.release()
+                    job.out.set_exception(e)
                 return True
             with self._lock:
-                self._inflight.append(job)
-                job.attempts += 1
-                job.sent_at = time.monotonic()
+                now = time.monotonic()
+                for job in jobs:
+                    self._inflight.append(job)
+                    job.attempts += 1
+                    job.sent_at = now
             try:
-                sockio.send_frame(sock, wire.FTYPE_DATA, job.header, job.buffers)
+                with self._send_mutex:
+                    sockio.send_frames(
+                        sock,
+                        [(wire.FTYPE_DATA, j.header, j.buffers)
+                         for j in jobs],
+                    )
                 return True
             except (OSError, ConnectionError) as e:
                 self._handle_break(e)
-                # _handle_break either requeued `job` for resend (it was
-                # unacked) or failed it; either way this dispatch is done
-                # once the resend path below drains.
+                # _handle_break either requeued the jobs for resend (they
+                # were unacked) or failed them; either way this dispatch
+                # is done once the resend path below drains.
                 if not self._resend_unacked():
                     return not self._closed
                 return True
@@ -215,11 +334,15 @@ class PipelinedLane:
                 self._fail_all_inflight(e)
                 return False
             try:
+                now = time.monotonic()
                 for job in pending:
                     job.attempts += 1
-                    job.sent_at = time.monotonic()
-                    sockio.send_frame(
-                        sock, wire.FTYPE_DATA, job.header, job.buffers
+                    job.sent_at = now
+                with self._send_mutex:
+                    sockio.send_frames(
+                        sock,
+                        [(wire.FTYPE_DATA, j.header, j.buffers)
+                         for j in pending],
                     )
                 return True
             except (OSError, ConnectionError) as e:

@@ -42,10 +42,33 @@ except ImportError:  # pragma: no cover - environment-dependent
 
 _SOCK_BUF = 8 * 1024 * 1024
 
+# Small-message fast path (receive-side IO shaping): frames whose payload
+# fits within this bound are received in one window — native builds pull
+# prefix+header+payload inside a single GIL release, the Python/TLS path
+# combines the header and payload reads. Independent of the *sender's*
+# configurable ``small_message_threshold``: this is a local buffering
+# decision, not a wire-format knob, so the two need not agree.
+SMALL_FRAME_MAX = 64 * 1024
 
-def _native_ok(sock) -> bool:
+# Coalesced sends at or below this total are joined into one buffer for a
+# single ``sendall`` on the Python/TLS path — one copy beats N syscalls
+# (and keeps TLS to one record per batch). Larger batches send
+# sequentially rather than double-buffer a big payload.
+_COALESCE_COPY_MAX = 256 * 1024
+
+# Sentinel for "caller did not pass a fastwire snapshot" — distinct from
+# None, which legitimately means "no native engine".
+_UNSET = object()
+
+
+def _native_ok(sock, fw=_UNSET) -> bool:
     # The fastwire path works on raw fds only; TLS stays on the ssl module.
-    return _fastwire is not None and not isinstance(sock, ssl.SSLSocket)
+    # Callers on a multi-step path pass their own snapshot of ``_fastwire``
+    # so one frame never sees the module global change mid-frame (tests
+    # swap it to force the Python path; see test_sockio.py).
+    if fw is _UNSET:
+        fw = _fastwire
+    return fw is not None and not isinstance(sock, ssl.SSLSocket)
 
 
 def _timeout_ms(sock: socket.socket) -> int:
@@ -62,33 +85,60 @@ def tune_socket(sock: socket.socket) -> None:
         pass
 
 
-def send_frame(sock: socket.socket, ftype: int, header: Dict,
-               buffers: Optional[List] = None) -> None:
-    buffers = buffers or []
-    payload_len = sum(memoryview(b).nbytes for b in buffers)
-    prefix = wire.encode_prefix_and_header(ftype, header, payload_len)
-    views = [wire.as_byte_view(b) for b in buffers]
-    views = [v for v in views if v.nbytes]
-    if _native_ok(sock):
+def send_frames(sock: socket.socket,
+                frames: List[Tuple[int, Dict, Optional[List]]]) -> None:
+    """Send one or more complete frames in a single vectored write.
+
+    ``frames`` is a list of (ftype, header, buffers). On native plaintext
+    sockets every prefix, header and payload buffer of the whole batch
+    goes out through one ``sendv`` (writev) call; the Python/TLS fallback
+    joins small batches into one ``sendall``. This is the syscall-level
+    half of the small-message coalescer: N queued small frames to the
+    same peer cost one syscall, not 2N.
+    """
+    fw = _fastwire
+    chunks: List = []
+    for ftype, header, buffers in frames:
+        buffers = buffers or []
+        payload_len = sum(memoryview(b).nbytes for b in buffers)
+        chunks.append(
+            wire.encode_prefix_and_header(ftype, header, payload_len)
+        )
+        for b in buffers:
+            v = wire.as_byte_view(b)
+            if v.nbytes:
+                chunks.append(v)
+    if _native_ok(sock, fw):
         try:
-            _fastwire.sendv(sock.fileno(), _timeout_ms(sock), [prefix] + views)
+            fw.sendv(sock.fileno(), _timeout_ms(sock), chunks)
             return
         except TimeoutError:
             raise socket.timeout("fastwire send timed out") from None
         except ValueError:
             # Stale v1 extension build: sendv capped at 64 iovecs ("too
             # many buffers") and nothing has been written yet — fall
-            # through to the Python sendall loop.
+            # through to the Python sendall path.
             pass
-    sock.sendall(prefix)
-    for view in views:
-        sock.sendall(view)
+    total = sum(memoryview(c).nbytes for c in chunks)
+    if len(chunks) > 1 and total <= _COALESCE_COPY_MAX:
+        sock.sendall(b"".join(chunks))
+        return
+    for chunk in chunks:
+        sock.sendall(chunk)
 
 
-def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
-    if _native_ok(sock):
+def send_frame(sock: socket.socket, ftype: int, header: Dict,
+               buffers: Optional[List] = None) -> None:
+    send_frames(sock, [(ftype, header, buffers)])
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview,
+                     fw=_UNSET) -> None:
+    if fw is _UNSET:
+        fw = _fastwire
+    if _native_ok(sock, fw):
         try:
-            _fastwire.recv_exact(sock.fileno(), _timeout_ms(sock), view)
+            fw.recv_exact(sock.fileno(), _timeout_ms(sock), view)
             return
         except TimeoutError:
             raise socket.timeout("fastwire recv timed out") from None
@@ -101,9 +151,9 @@ def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
         got += n
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+def _recv_exact(sock: socket.socket, n: int, fw=_UNSET) -> bytearray:
     buf = bytearray(n)
-    _recv_exact_into(sock, memoryview(buf))
+    _recv_exact_into(sock, memoryview(buf), fw)
     return buf
 
 
@@ -250,12 +300,16 @@ def _pool_max_bytes() -> int:
 
 # FEDTPU_RECV_POOL_MB bounds the TOTAL receive-pool memory of the process.
 # When the native extension is loaded, its C-side pool (which reads the
-# same env var) serves every plaintext connection and owns the whole
-# budget; the Python pool stands down so the two pools cannot each retain
-# a full cap. TLS connections then receive into unpooled buffers — they
-# already pay per-byte crypto, so recycling is not their bottleneck.
+# same env var) serves every plaintext connection; the Python pool keeps a
+# quarter-cap residual budget for the TLS connections that still ride the
+# Python receive path (they pay per-byte crypto, but a fresh 100MB
+# allocation per frame still costs page faults + munmap). Worst case the
+# process retains 1.25x the configured cap — documented trade against
+# TLS receivers getting zero recycling. Without the native engine the
+# Python pool owns the whole budget.
 _RECV_POOL = BufferPool(
-    0 if (_fastwire is not None and hasattr(_fastwire, "recv_prefix_header"))
+    _pool_max_bytes() // 4
+    if (_fastwire is not None and hasattr(_fastwire, "recv_prefix_header"))
     else _pool_max_bytes()
 )
 
@@ -272,11 +326,15 @@ def recv_frame(
 
     On plaintext sockets with the native extension available, the whole
     receive path (prefix+header read, validation, pooled payload buffers,
-    scatter readv) runs in C++ (two GIL-released windows per frame —
-    the role gRPC's C-core plays for the reference's data plane)."""
-    if _native_ok(sock) and hasattr(_fastwire, "recv_prefix_header"):
-        return _recv_frame_native(sock, max_payload)
-    prefix = _recv_exact(sock, wire.PREFIX_LEN)
+    scatter readv) runs in C++ (the role gRPC's C-core plays for the
+    reference's data plane). Frames whose payload fits SMALL_FRAME_MAX
+    ride a one-window fast lane: the native engine pulls prefix, header
+    and payload inside a single GIL release; the Python path combines
+    the header+payload reads into one recv."""
+    fw = _fastwire  # snapshot: one frame never mixes native/Python steps
+    if _native_ok(sock, fw) and hasattr(fw, "recv_prefix_header"):
+        return _recv_frame_native(sock, max_payload, fw)
+    prefix = _recv_exact(sock, wire.PREFIX_LEN, fw)
     magic, version, ftype, hlen, plen = wire._PREFIX.unpack(bytes(prefix))
     if magic != wire.WIRE_MAGIC:
         raise wire.WireError(f"bad magic {magic!r}")
@@ -287,7 +345,13 @@ def recv_frame(
     cap = _effective_cap(max_payload)
     if plen > cap:
         raise wire.WireError(f"payload length {plen} exceeds cap {cap}")
-    header = msgpack.unpackb(bytes(_recv_exact(sock, hlen)), raw=False)
+    if plen and plen <= SMALL_FRAME_MAX:
+        # Small frame: header + payload in one read (2 recv windows per
+        # frame instead of 3; the payload view stays writable).
+        buf = memoryview(_recv_exact(sock, hlen + plen, fw))
+        header = msgpack.unpackb(bytes(buf[:hlen]), raw=False)
+        return ftype, header, buf[hlen:]
+    header = msgpack.unpackb(bytes(_recv_exact(sock, hlen, fw)), raw=False)
     if not plen:
         return ftype, header, memoryview(b"")
     # Buffers come from the recycling pool (np.empty also skips the
@@ -301,27 +365,40 @@ def recv_frame(
         pos = 0
         for n in sizes:
             buf = _RECV_POOL.take(n)
-            _recv_exact_into(sock, memoryview(buf))
+            _recv_exact_into(sock, memoryview(buf), fw)
             segments.append((pos, buf))
             pos += n
         return ftype, header, serialization.SegmentedPayload(segments)
 
     payload = _RECV_POOL.take(plen)
-    _recv_exact_into(sock, memoryview(payload))
+    _recv_exact_into(sock, memoryview(payload), fw)
     return ftype, header, memoryview(payload)
 
 
-def _recv_frame_native(sock: socket.socket, max_payload: Optional[int]):
-    """Native (C++) receive path: one GIL window for prefix+header (with
-    validation before allocation), one for the entire payload scatter-read
-    into C-pooled buffers."""
+def _recv_frame_native(sock: socket.socket, max_payload: Optional[int], fw):
+    """Native (C++) receive path. Small frames (payload within
+    SMALL_FRAME_MAX): ONE GIL window for the whole frame via
+    ``recv_frame_small``. Large frames: one window for prefix+header
+    (validation before allocation), one for the payload scatter-read into
+    C-pooled buffers. ``fw`` is the caller's snapshot of the fastwire
+    module — taken once per frame so a concurrent swap of the module
+    global (tests forcing the Python path) cannot split one frame across
+    engines."""
     timeout_ms = _timeout_ms(sock)
     fd = sock.fileno()
+    small = None
     try:
-        ftype, plen, hbytes = _fastwire.recv_prefix_header(
-            fd, timeout_ms, wire.WIRE_MAGIC, wire.WIRE_VERSION,
-            wire._MAX_HEADER, _effective_cap(max_payload),
-        )
+        if hasattr(fw, "recv_frame_small"):
+            ftype, plen, hbytes, small = fw.recv_frame_small(
+                fd, timeout_ms, wire.WIRE_MAGIC, wire.WIRE_VERSION,
+                wire._MAX_HEADER, _effective_cap(max_payload),
+                SMALL_FRAME_MAX,
+            )
+        else:  # stale extension build without the small-frame lane
+            ftype, plen, hbytes = fw.recv_prefix_header(
+                fd, timeout_ms, wire.WIRE_MAGIC, wire.WIRE_VERSION,
+                wire._MAX_HEADER, _effective_cap(max_payload),
+            )
     except TimeoutError:
         raise socket.timeout("fastwire recv timed out") from None
     except ValueError as e:  # protocol violation detected in C
@@ -329,11 +406,13 @@ def _recv_frame_native(sock: socket.socket, max_payload: Optional[int]):
     header = msgpack.unpackb(hbytes, raw=False)
     if not plen:
         return ftype, header, memoryview(b"")
+    if small is not None:
+        return ftype, header, memoryview(small)
     from rayfed_tpu._private import serialization
 
     sizes = _segment_sizes(header, plen)
     try:
-        bufs = _fastwire.recv_scatter(fd, timeout_ms, sizes or [plen])
+        bufs = fw.recv_scatter(fd, timeout_ms, sizes or [plen])
     except TimeoutError:
         raise socket.timeout("fastwire recv timed out") from None
     if sizes is None:

@@ -44,11 +44,11 @@ import ssl
 import threading
 import time
 from concurrent.futures import Future
-from queue import Queue
+from queue import Empty, Queue
 from typing import Dict, Optional, Tuple
 
 from rayfed_tpu import tracing
-from rayfed_tpu._private import serialization
+from rayfed_tpu._private import executor, serialization
 from rayfed_tpu._private.constants import (
     CODE_FORBIDDEN,
     CODE_INTERNAL_ERROR,
@@ -96,6 +96,9 @@ class _DestWorker(threading.Thread):
         self._sock: Optional[socket.socket] = None
         self._closed = False
         self._lane = None
+        self._small_threshold = max(
+            0, getattr(self._cfg, "small_message_threshold", 0) or 0
+        )
         if not wire.tls_enabled(proxy._tls_config):
             # Plaintext connections pipeline frames (window of unacked
             # sends); TLS keeps half-duplex request-response because
@@ -114,6 +117,7 @@ class _DestWorker(threading.Thread):
                 ack_timeout_s=self._cfg.timeout_in_ms / 1000,
                 on_ack=bump_acks,
                 window=self._cfg.send_window,
+                small_threshold=self._small_threshold,
             )
         self.start()
 
@@ -205,7 +209,18 @@ class _DestWorker(threading.Thread):
             job = self._jobs.get()
             if job is None:
                 self._drop_sock()
-                return
+                # Fail anything queued behind the close sentinel (a
+                # deferred fast-send fallback can race close) — stranded
+                # jobs would leave their futures unresolved forever.
+                while True:
+                    try:
+                        late = self._jobs.get_nowait()
+                    except Empty:
+                        return
+                    if late is not None and not late[0].done():
+                        late[0].set_exception(
+                            ConnectionError("sender stopped")
+                        )
             out, data, upstream_seq_id, downstream_seq_id, is_error = job
             try:
                 header, buffers, payload_len, on_done = self._prepare(
@@ -214,34 +229,117 @@ class _DestWorker(threading.Thread):
             except BaseException as e:  # noqa: BLE001 - routed to drain
                 out.set_exception(e)
                 continue
-            if on_done is not None:
-                # Alternate-lane accounting hook (device-DMA failed-send
-                # leak bound): tell the lane whether the descriptor frame
-                # was actually delivered.
-                def _notify(f, cb=on_done):
-                    try:
-                        cb(f.exception() is None and f.result() is True)
-                    except Exception:  # noqa: BLE001 - accounting only
-                        logger.exception("send on_done callback failed")
-
-                out.add_done_callback(_notify)
-            if tracing.is_enabled():
-                t0 = time.perf_counter()
-                nbytes = payload_len
-                out.add_done_callback(
-                    lambda f, t0=t0, nbytes=nbytes, up=upstream_seq_id,
-                    down=downstream_seq_id: tracing.record(
-                        "send", self._dest, up, down, nbytes, t0,
-                        ok=f.exception() is None,
-                    )
-                )
+            self._attach_done_callbacks(
+                out, on_done, payload_len, upstream_seq_id,
+                downstream_seq_id,
+            )
             if self._lane is not None:
-                self._lane.submit(out, header, buffers)
+                self._lane.submit(out, header, buffers, payload_len)
                 continue
             try:
                 out.set_result(self._send_half_duplex(header, buffers))
             except BaseException as e:  # noqa: BLE001 - routed to drain
                 out.set_exception(e)
+
+    def _attach_done_callbacks(self, out, on_done, payload_len,
+                               upstream_seq_id, downstream_seq_id) -> None:
+        if on_done is not None:
+            # Alternate-lane accounting hook (device-DMA failed-send
+            # leak bound): tell the lane whether the descriptor frame
+            # was actually delivered.
+            def _notify(f, cb=on_done):
+                try:
+                    cb(f.exception() is None and f.result() is True)
+                except Exception:  # noqa: BLE001 - accounting only
+                    logger.exception("send on_done callback failed")
+
+            out.add_done_callback(_notify)
+        if tracing.is_enabled():
+            t0 = time.perf_counter()
+            out.add_done_callback(
+                lambda f, t0=t0, nbytes=payload_len, up=upstream_seq_id,
+                down=downstream_seq_id: tracing.record(
+                    "send", self._dest, up, down, nbytes, t0,
+                    ok=f.exception() is None,
+                )
+            )
+
+    def try_fast_send(self, out: Future, data, upstream_seq_id,
+                      downstream_seq_id, is_error: bool) -> bool:
+        """Inline small-send path: encode and hand the frame straight to
+        the pipelined lane WITHOUT a worker-queue hop. A value that is
+        ready now is sent on the caller's thread; a still-pending value
+        future gets a done-callback that finishes the send on the thread
+        that completes it (usually the executor worker that produced the
+        value) — the common case on the latency-critical chain, where
+        send() runs before the producing task has finished. Returns
+        False to decline — the caller then queues the job on the worker,
+        which produces the canonical error handling; the deferred path
+        falls back to the same queue on any failure.
+
+        Declines unless: the pipelined lane exists (plaintext only), the
+        fast path is enabled, the payload's encoded size provably fits
+        the threshold, and the device-DMA lane is off (its register step
+        is not vetted for arbitrary caller threads). Reordering against
+        queued worker jobs is safe: every (up, down) edge is a unique
+        rendezvous key, and error envelopes (which reuse an edge) never
+        take this path."""
+        thr = self._small_threshold
+        if (
+            self._lane is None
+            or thr <= 0
+            or self._closed
+            or is_error
+            or getattr(self._cfg, "device_dma", False)
+        ):
+            return False
+        if isinstance(data, Future) and not data.done():
+            job = (out, data, upstream_seq_id, downstream_seq_id, is_error)
+
+            def _on_ready(f):
+                try:
+                    sent = (
+                        f.exception() is None
+                        and self._finish_fast_send(
+                            out, f.result(), upstream_seq_id,
+                            downstream_seq_id,
+                        )
+                    )
+                except BaseException:  # noqa: BLE001 - worker re-raises
+                    sent = False
+                if not sent:
+                    self.submit(job)
+
+            data.add_done_callback(_on_ready)
+            return True
+        resolved, value = executor.try_resolved(data)
+        if not resolved:
+            return False
+        return self._finish_fast_send(
+            out, value, upstream_seq_id, downstream_seq_id
+        )
+
+    def _finish_fast_send(self, out: Future, value, upstream_seq_id,
+                          downstream_seq_id) -> bool:
+        """Encode + dispatch an already-resolved success value on the
+        current thread. False declines to the worker queue."""
+        if self._closed:
+            return False
+        if not serialization.quick_payload_bound(
+            value, self._small_threshold
+        ):
+            return False
+        try:
+            header, buffers, payload_len, on_done = self._prepare(
+                value, upstream_seq_id, downstream_seq_id, False
+            )
+        except BaseException:  # noqa: BLE001 - worker path re-raises it
+            return False
+        self._attach_done_callbacks(
+            out, on_done, payload_len, upstream_seq_id, downstream_seq_id
+        )
+        self._lane.submit(out, header, buffers, payload_len)
+        return True
 
     def _prepare(self, data, upstream_seq_id, downstream_seq_id,
                  is_error: bool):
@@ -280,6 +378,7 @@ class _DestWorker(threading.Thread):
             wire_dtype=serialization.wire_dtype_name(
                 getattr(cfg, "payload_wire_dtype", None)
             ),
+            small_threshold=self._small_threshold,
         )
         if kind == "pickle" and not cfg.allow_pickle_payloads and not is_error:
             raise ValueError(
@@ -295,7 +394,14 @@ class _DestWorker(threading.Thread):
             )
         header["pkind"] = kind
         header["pmeta"] = meta
-        if cfg.payload_compression and payload_len:
+        # Sub-threshold payloads skip compression: at kilobyte scale the
+        # compressor's fixed cost exceeds any wire-time saving, and the
+        # fast receive lane wants raw bytes.
+        if (
+            cfg.payload_compression
+            and payload_len
+            and payload_len > self._small_threshold
+        ):
             packed = serialization.compress_buffers(
                 buffers, cfg.payload_compression, cfg.compression_level
             )
@@ -407,6 +513,10 @@ class TcpSenderProxy(SenderProxy):
             if worker is None or worker._closed:
                 worker = _DestWorker(self, dest_party)
                 self._workers[dest_party] = worker
+        if worker.try_fast_send(
+            out, data, upstream_seq_id, downstream_seq_id, is_error
+        ):
+            return out
         worker.submit((out, data, upstream_seq_id, downstream_seq_id, is_error))
         return out
 
@@ -574,7 +684,45 @@ class TcpReceiverProxy(ReceiverProxy):
                 daemon=True,
             ).start()
 
+    # Hard flush bound for batched acks. Deliberately above the default
+    # send window (8): a sender stalls only when its window fills, which
+    # happens well before 32 deferred acks — so batching can never
+    # livelock the pipe, while a burst of small frames gets its acks in
+    # one write instead of one syscall each.
+    _ACK_FLUSH_MAX = 32
+
+    @staticmethod
+    def _data_ready(conn) -> bool:
+        """True when another frame can be read without blocking (buffered
+        TLS bytes count). Used to defer ack writes while a burst is still
+        arriving."""
+        if isinstance(conn, ssl.SSLSocket) and conn.pending():
+            return True
+        import select
+
+        try:
+            ready, _, _ = select.select([conn], [], [], 0)
+        except (OSError, ValueError):
+            return False
+        return bool(ready)
+
     def _serve_conn(self, conn: socket.socket, peer, ssl_ctx) -> None:
+        # RESP frames are fully encoded on queue (plen is always 0) and
+        # flushed in one write when the inbound burst pauses — ack
+        # piggybacking: N small frames cost one ack syscall, not N.
+        pending_acks: list = []
+
+        def queue_resp(resp_header: Dict) -> None:
+            pending_acks.append(
+                wire.encode_prefix_and_header(wire.FTYPE_RESP, resp_header, 0)
+            )
+
+        def flush_acks() -> None:
+            if pending_acks:
+                blob = b"".join(pending_acks)
+                pending_acks.clear()
+                conn.sendall(blob)
+
         try:
             sockio.tune_socket(conn)
             peer_ids = None
@@ -587,6 +735,11 @@ class TcpReceiverProxy(ReceiverProxy):
             with self._conn_lock:
                 self._open_conns.add(conn)
             while not self._stopping:
+                if pending_acks and (
+                    len(pending_acks) >= self._ACK_FLUSH_MAX
+                    or not self._data_ready(conn)
+                ):
+                    flush_acks()
                 try:
                     ftype, header, payload = sockio.recv_frame(
                         conn,
@@ -600,8 +753,7 @@ class TcpReceiverProxy(ReceiverProxy):
                     logger.warning("dropping connection from %s: %s", peer, e)
                     return
                 if ftype != wire.FTYPE_DATA:
-                    sockio.send_frame(
-                        conn, wire.FTYPE_RESP,
+                    queue_resp(
                         {"code": CODE_INTERNAL_ERROR,
                          "msg": "expected DATA frame"},
                     )
@@ -614,8 +766,7 @@ class TcpReceiverProxy(ReceiverProxy):
                         "by peer certificate identities %s",
                         peer, header.get("src"), sorted(peer_ids),
                     )
-                    sockio.send_frame(
-                        conn, wire.FTYPE_RESP,
+                    queue_resp(
                         {"code": CODE_FORBIDDEN,
                          "msg": "peer certificate does not attest claimed "
                                 "src party",
@@ -625,8 +776,7 @@ class TcpReceiverProxy(ReceiverProxy):
                 code, msg = self._store.offer(header, payload)
                 # Echo the sender's frame sequence number: pipelined acks
                 # are matched by fseq, never by position.
-                sockio.send_frame(
-                    conn, wire.FTYPE_RESP,
+                queue_resp(
                     {"code": code, "msg": msg, "fseq": header.get("fseq")},
                 )
         except ssl.SSLError as e:
@@ -635,6 +785,10 @@ class TcpReceiverProxy(ReceiverProxy):
             if not self._stopping:
                 logger.warning("receiver connection from %s failed: %s", peer, e)
         finally:
+            try:
+                flush_acks()  # best-effort: acks owed before teardown
+            except (OSError, ValueError):
+                pass
             with self._conn_lock:
                 self._open_conns.discard(conn)
             try:

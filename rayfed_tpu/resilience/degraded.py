@@ -123,7 +123,9 @@ def resolve_with_policy(
     for i, f in enumerate(futures):
         budget = None if t_end is None else max(0.0, t_end - time.monotonic())
         try:
-            values.append(f.result(timeout=budget))
+            from rayfed_tpu._private.executor import result_stealing
+
+            values.append(result_stealing(f, timeout=budget))
             continue
         except BaseException as e:  # noqa: BLE001 - classified below
             if on_missing == "raise" or not is_missing_error(e):

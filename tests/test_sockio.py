@@ -29,20 +29,30 @@ def roundtrip_frame(header, buffers, max_payload=None, force_python=False):
     result = {}
 
     def reader():
-        result["frame"] = sockio.recv_frame(b, max_payload=max_payload)
+        try:
+            result["frame"] = sockio.recv_frame(b, max_payload=max_payload)
+        except BaseException as e:  # noqa: BLE001 - re-raised in the test
+            result["error"] = e
 
-    t = threading.Thread(target=reader)
-    t.start()
+    # Swap the lane BEFORE the reader thread starts and restore only
+    # after it joins: recv_frame snapshots sockio._fastwire once at
+    # entry, so flipping it mid-frame under the reader's feet would race
+    # (the [True] param flaked exactly that way before the snapshot).
     old = sockio._fastwire
     if force_python:
         sockio._fastwire = None
+    t = threading.Thread(target=reader)
+    t.start()
     try:
         sockio.send_frame(a, wire.FTYPE_DATA, header, buffers)
+        t.join(timeout=10)
     finally:
         sockio._fastwire = old
-    t.join(timeout=10)
-    a.close()
-    b.close()
+        a.close()
+        b.close()
+    assert not t.is_alive(), "reader thread did not finish within 10s"
+    if "error" in result:
+        raise result["error"]
     return result["frame"]
 
 

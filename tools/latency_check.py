@@ -1,0 +1,84 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Latency regression gate for the small-message fast path.
+
+Runs the many-tiny-tasks micro-benchmark (bench.py's tiny stage: two
+spawned parties, hundreds of sub-millisecond federated rounds over
+loopback TCP) and FAILS LOUDLY — exit code 1 — when the measured
+``tiny_task_overhead_ms`` exceeds the budget. Wire this into CI so a
+change that quietly re-adds a thread hop or a pickle round to the small
+message path turns the build red instead of shipping.
+
+Budget (ms per federated task):
+
+  FEDTPU_TINY_BUDGET_MS   default 1.0 — generous vs the ~0.4 ms measured
+                          on the 1-core CI host class, so host noise does
+                          not flake the gate, while a lost fast path
+                          (2x+ regressions were the pre-fast-path norm at
+                          threshold=0 plus a queued hop per send) still
+                          trips it. Tighten on dedicated hardware.
+  FEDTPU_TINY_ROUNDS      default 300 rounds (per measured repetition).
+  FEDTPU_TINY_REPS        default 3; the BEST repetition is compared —
+                          the gate asks "can the code still go this
+                          fast", not "was the host busy".
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    budget_ms = float(os.environ.get("FEDTPU_TINY_BUDGET_MS", "1.0"))
+    rounds = int(os.environ.get("FEDTPU_TINY_ROUNDS", "300"))
+    reps = int(os.environ.get("FEDTPU_TINY_REPS", "3"))
+
+    samples = []
+    for rep in range(reps):
+        res = bench._run_two_party(
+            bench._tiny_party, "tcp", (rounds,), timeout_s=300
+        )
+        ms = res["per_task_ms"]
+        samples.append(ms)
+        print(f"rep {rep + 1}/{reps}: tiny_task_overhead_ms={ms:.3f}",
+              flush=True)
+
+    best = min(samples)
+    print(f"best of {reps}: {best:.3f} ms/task (budget {budget_ms:.3f})")
+    if best > budget_ms:
+        print(
+            f"LATENCY REGRESSION: tiny_task_overhead_ms={best:.3f} exceeds "
+            f"the {budget_ms:.3f} ms budget across all {reps} repetitions.\n"
+            f"The small-message fast path is the usual suspect: check that "
+            f"sub-threshold sends still take the inline lane "
+            f"(cross_silo_comm.small_message_threshold > 0), that the "
+            f"compact 'mp' codec still engages, and that no new thread hop "
+            f"landed on the send/recv path. samples={samples}",
+            file=sys.stderr,
+        )
+        return 1
+    print("latency gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
