@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency clean
+.PHONY: native test lint chaos latency scale clean
 
 native:
 	python setup.py build_ext --inplace
@@ -31,6 +31,15 @@ chaos:
 # .github/workflows/tests.yml.
 latency:
 	JAX_PLATFORMS=cpu python tools/latency_check.py
+
+# Scale gate: 8- and 16-party simulated hierarchical rounds (real TCP
+# proxies over shared epoll reactors, in-process parties) must keep
+# their MEDIAN round under budget, with a hard wall-clock cap on the
+# whole check — a serialized reactor loop or re-added per-peer thread
+# hop fails loudly here. Mirrors the `scale` job in
+# .github/workflows/tests.yml.
+scale:
+	JAX_PLATFORMS=cpu python tools/scale_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -81,6 +81,11 @@ def _party_entry(target, party, *rest):
     progress dir before the party body runs, so the parent's watchdog
     can capture WHERE a hung party is stuck — not just the last phase
     mark (BENCH_r05's "bench party hung" had no stack to go on)."""
+    # gRPC-core WARNING logs (retry_service_config.cc's maxAttempts clamp
+    # note among them) come from channels jaxlib creates internally, not
+    # from this repo's pre-clamped config — silence them below ERROR so
+    # bench stderr stays parseable (see test_grpc_channel_options).
+    os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
     d = os.environ.get(_PROGRESS_DIR_VAR)
     if d:
         try:
@@ -719,10 +724,25 @@ def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
         with _cpu_forced() if cpu_force else contextlib.nullcontext():
             rounds = int(os.environ.get(env_var, default_rounds))
             for transport, key in keys:
-                res = _run_two_party(
-                    party_fn, transport, (rounds,),
-                    timeout_s=timeout_s, parties=parties,
-                )
+                # One retry per phase: the recurring gRPC-lane hang
+                # (BENCH_r05 "_fedavg_party bench skipped") is a
+                # once-per-run wedge, so a surviving second window keeps
+                # the key populated instead of dropping it.
+                for attempt in (1, 2):
+                    try:
+                        res = _run_two_party(
+                            party_fn, transport, (rounds,),
+                            timeout_s=timeout_s, parties=parties,
+                        )
+                        break
+                    except Exception as e:  # noqa: BLE001 - retried once
+                        if attempt == 2:
+                            raise
+                        print(
+                            f"{party_fn.__name__} [{key}] window failed "
+                            f"({e!r}); retrying the phase once",
+                            file=sys.stderr,
+                        )
                 out[key] = round(res[res_field], digits)
                 for rf, out_key in (extra_fields or {}).items():
                     v = res.get(rf)
@@ -798,6 +818,157 @@ def _hier4_party(party, addresses, transport, result_path, rounds):
                 f,
             )
     fed.shutdown()
+
+
+# --- N-party scale sweep (reactor transport + topology planner) -----------
+#
+# Spawning 64 real party processes on a shared 1-2 core CI VM measures the
+# scheduler, not the transport. Instead the sweep simulates N parties in
+# ONE process: each party is a real TcpSenderProxy + TcpReceiverProxy pair
+# (real sockets, real frames, real acks — all riding the shared reactor
+# loops), and each round executes a planned hierarchical reduction whose
+# edges are actual wire transfers. What's simulated is only process
+# isolation; the transport path is the production one.
+
+_SCALE_NS = (8, 16, 32, 64)
+
+
+def _simulated_hier_round(n_parties: int, rounds: int,
+                          payload_elems: int = 16384,
+                          topology: str = "hier") -> dict:
+    """Median round latency for an N-party planned reduction where every
+    reduce edge is a real proxy-to-proxy transfer. Returns
+    {"round_ms_median", "round_ms_spread", "rounds"}."""
+    import statistics
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    from rayfed_tpu import topology as topo
+    from rayfed_tpu.proxy.tcp.tcp_proxy import (
+        TcpReceiverProxy,
+        TcpSenderProxy,
+    )
+
+    parties = [f"p{i:02d}" for i in range(n_parties)]
+    ports = _free_ports(n_parties)
+    addresses = {p: f"127.0.0.1:{port}" for p, port in zip(parties, ports)}
+    cfg = {
+        "timeout_in_ms": 30000,
+        "connect_timeout_in_ms": 5000,
+        "retry_policy": {
+            "max_attempts": 3,
+            "initial_backoff_ms": 50,
+            "max_backoff_ms": 500,
+            "backoff_multiplier": 2.0,
+        },
+        "num_reactors": 4,
+    }
+    plan = topo.plan(parties, topology)
+    receivers, senders = {}, {}
+    try:
+        for p in parties:
+            rp = TcpReceiverProxy(addresses[p], p, "bench-scale", None,
+                                  dict(cfg))
+            rp.start()
+            ok, err = rp.is_ready()
+            if not ok:
+                raise RuntimeError(f"receiver for {p} not ready: {err}")
+            receivers[p] = rp
+        for p in parties:
+            sp = TcpSenderProxy(addresses, p, "bench-scale", None, dict(cfg))
+            sp.start()
+            senders[p] = sp
+
+        base = {
+            p: np.full((payload_elems,), float(i + 1), np.float32)
+            for i, p in enumerate(parties)
+        }
+        expect = float(sum(range(1, n_parties + 1))) / n_parties
+
+        def one_round(r: int) -> None:
+            held = dict(base)
+            for li, level in enumerate(plan.levels):
+                def do_step(step):
+                    futs = []
+                    for s in step.srcs[1:]:
+                        seq = f"r{r}L{li}:{s}>{step.dst}"
+                        futs.append(
+                            (receivers[step.dst].get_data(s, seq, seq),
+                             senders[s].send(step.dst, held[s], seq, seq))
+                        )
+                    acc = held[step.srcs[0]].astype(np.float32)
+                    for recv_fut, send_fut in futs:
+                        send_fut.result(60)
+                        acc = acc + np.asarray(recv_fut.result(60),
+                                               np.float32)
+                    return step.dst, acc
+                with ThreadPoolExecutor(
+                    max_workers=max(1, min(32, len(level)))
+                ) as pool:
+                    for dst, acc in pool.map(do_step, level):
+                        held[dst] = acc
+            out = held[plan.root] / float(n_parties)
+            # Integer-valued contributions: the planned fold is exact, so
+            # a wrong aggregate is a transport bug, not float noise.
+            assert float(out[0]) == expect, (float(out[0]), expect)
+
+        one_round(-1)  # warmup: dial every edge, prime the reactor rings
+        dts = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            one_round(r)
+            dts.append((time.perf_counter() - t0) * 1000)
+        return {
+            "round_ms_median": statistics.median(dts),
+            "round_ms_spread": [min(dts), max(dts)],
+            "rounds": rounds,
+        }
+    finally:
+        for sp in senders.values():
+            try:
+                sp.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        for rp in receivers.values():
+            try:
+                rp.stop()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
+def _run_scale_sweep() -> dict:
+    """``hierN_round_ms`` for N in 8/16/32/64 + ``parties_sustained``
+    (largest N whose sweep completed). Median-of-rounds (same noise
+    treatment as hier4) so the keys are CI-gateable."""
+    out = {}
+    rounds = int(os.environ.get("FEDTPU_BENCH_SCALE_ROUNDS", 5))
+    ns = [
+        int(x) for x in os.environ.get(
+            "FEDTPU_BENCH_SCALE_NS",
+            ",".join(str(n) for n in _SCALE_NS),
+        ).split(",") if x
+    ]
+    sustained = 0
+    for n in ns:
+        # Small-N rounds are cheap: take more of them so the median the
+        # scaling ratio divides by sits in the steady-state regime (a
+        # lucky 5-round N=8 window can halve the denominator on this
+        # class of shared VM).
+        n_rounds = max(rounds, min(160 // max(1, n), 20))
+        try:
+            res = _simulated_hier_round(n, n_rounds)
+        except Exception as e:  # noqa: BLE001 - keep smaller-N keys
+            print(f"scale bench skipped at N={n}: {e!r}", file=sys.stderr)
+            break
+        out[f"hier{n}_round_ms"] = round(res["round_ms_median"], 2)
+        out[f"hier{n}_round_ms_spread"] = [
+            round(x, 2) for x in res["round_ms_spread"]
+        ]
+        sustained = n
+    if sustained:
+        out["parties_sustained"] = sustained
+    return out
 
 
 def _cnn_party(party, addresses, transport, result_path, rounds):
@@ -1084,6 +1255,11 @@ def main() -> None:
         _cnn_party, "round_ms", "FEDTPU_BENCH_CNN_ROUNDS", 5,
         [("tcp", "fedavg_cnn_round_ms")], cpu_force=True, timeout_s=420,
     ))
+    # N-party scale sweep (in-process simulated parties, real wire edges).
+    try:
+        result.update(_run_scale_sweep())
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"scale sweep skipped: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 

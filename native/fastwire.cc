@@ -28,8 +28,10 @@
 #include <poll.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
+#include <unistd.h>
 
 #include <mutex>
 #include <vector>
@@ -685,6 +687,269 @@ static PyObject *fastwire_recv_scatter(PyObject *self, PyObject *args) {
 }
 
 /* ------------------------------------------------------------------ */
+/* epoll reactor core                                                  */
+/* ------------------------------------------------------------------ */
+
+/* reactor_new() -> epfd (close-on-exec). The Python reactor thread owns
+ * the fd and closes it with reactor_close(). */
+static PyObject *fastwire_reactor_new(PyObject *self, PyObject *args) {
+    int epfd = epoll_create1(EPOLL_CLOEXEC);
+    if (epfd < 0) return PyErr_SetFromErrno(PyExc_OSError);
+    return PyLong_FromLong(epfd);
+}
+
+static PyObject *fastwire_reactor_close(PyObject *self, PyObject *args) {
+    int epfd;
+    if (!PyArg_ParseTuple(args, "i", &epfd)) return NULL;
+    close(epfd);
+    Py_RETURN_NONE;
+}
+
+/* reactor_ctl(epfd, op, fd, events) -> None
+ * op: 1 add, 2 del, 3 mod (the kernel EPOLL_CTL_* values). ``events`` is
+ * the raw epoll event mask (select.EPOLLIN|...). Level-triggered on
+ * purpose: interest management (read always on, write only while the
+ * send ring is non-empty) lives in Python, and level semantics make a
+ * missed edge impossible. */
+static PyObject *fastwire_reactor_ctl(PyObject *self, PyObject *args) {
+    int epfd, op, fd;
+    unsigned int events;
+    if (!PyArg_ParseTuple(args, "iiiI", &epfd, &op, &fd, &events))
+        return NULL;
+    struct epoll_event ev;
+    memset(&ev, 0, sizeof(ev));
+    ev.events = events;
+    ev.data.fd = fd;
+    if (epoll_ctl(epfd, op, fd, &ev) < 0)
+        return PyErr_SetFromErrno(PyExc_OSError);
+    Py_RETURN_NONE;
+}
+
+#define REACTOR_MAX_EVENTS 128
+
+/* reactor_wait(epfd, timeout_ms) -> list[(fd, events)]
+ * One GIL-released epoll_wait, whole ready set in one call. */
+static PyObject *fastwire_reactor_wait(PyObject *self, PyObject *args) {
+    int epfd;
+    long timeout_ms;
+    if (!PyArg_ParseTuple(args, "il", &epfd, &timeout_ms)) return NULL;
+
+    struct epoll_event evs[REACTOR_MAX_EVENTS];
+    int n, err = 0;
+    Py_BEGIN_ALLOW_THREADS;
+    for (;;) {
+        n = epoll_wait(epfd, evs, REACTOR_MAX_EVENTS,
+                       timeout_ms < 0 ? -1 : (int)timeout_ms);
+        if (n >= 0) break;
+        if (errno == EINTR) continue;
+        err = errno;
+        break;
+    }
+    Py_END_ALLOW_THREADS;
+    if (err != 0) {
+        errno = err;
+        return PyErr_SetFromErrno(PyExc_OSError);
+    }
+    PyObject *out = PyList_New(n);
+    if (!out) return NULL;
+    for (int i = 0; i < n; i++) {
+        PyObject *t = Py_BuildValue("(iI)", evs[i].data.fd,
+                                    (unsigned int)evs[i].events);
+        if (!t) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, t);
+    }
+    return out;
+}
+
+/* Nonblocking vectored write of one connection's ready chunks.
+ * Returns bytes written (>= 0; 0 means EAGAIN before any byte), or
+ * -errno on a hard socket error. Never raises for socket errors — the
+ * caller maps negatives to its break machinery. Caller must NOT hold
+ * buffer views it mutates concurrently. */
+static ssize_t writev_nb(int fd, std::vector<struct iovec> &iov) {
+    size_t first = 0, sent = 0;
+    while (first < iov.size()) {
+        while (first < iov.size() && iov[first].iov_len == 0) first++;
+        if (first >= iov.size()) break;
+        int cnt = (int)(iov.size() - first);
+        if (cnt > MAX_IOV) cnt = MAX_IOV;
+        ssize_t rc = writev(fd, &iov[first], cnt);
+        if (rc < 0) {
+            if (errno == EINTR) continue;
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            return -(ssize_t)errno;
+        }
+        sent += (size_t)rc;
+        size_t done = (size_t)rc;
+        while (done > 0 && first < iov.size()) {
+            if (done >= iov[first].iov_len) {
+                done -= iov[first].iov_len;
+                iov[first].iov_len = 0;
+                first++;
+            } else {
+                iov[first].iov_base = (char *)iov[first].iov_base + done;
+                iov[first].iov_len -= done;
+                done = 0;
+            }
+        }
+    }
+    return (ssize_t)sent;
+}
+
+/* Collect buffer views for one job into (views, iov). Returns 0 ok. */
+static int collect_iov(PyObject *bufseq, std::vector<Py_buffer> &views,
+                       std::vector<struct iovec> &iov) {
+    PyObject *fast = PySequence_Fast(bufseq, "buffers must be a sequence");
+    if (!fast) return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_C_CONTIGUOUS) < 0) {
+            Py_DECREF(fast);
+            return -1;
+        }
+        views.push_back(view);
+        struct iovec v;
+        v.iov_base = view.buf;
+        v.iov_len = (size_t)view.len;
+        iov.push_back(v);
+    }
+    Py_DECREF(fast);
+    return 0;
+}
+
+/* sendv_nb(fd, buffers) -> int
+ * One nonblocking gather-write; partial writes are the caller's problem
+ * (it advances its send ring by the return value). */
+static PyObject *fastwire_sendv_nb(PyObject *self, PyObject *args) {
+    int fd;
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "iO", &fd, &seq)) return NULL;
+
+    std::vector<Py_buffer> views;
+    std::vector<struct iovec> iov;
+    if (collect_iov(seq, views, iov) < 0) {
+        for (auto &v : views) PyBuffer_Release(&v);
+        return NULL;
+    }
+    ssize_t rc;
+    Py_BEGIN_ALLOW_THREADS;
+    rc = writev_nb(fd, iov);
+    Py_END_ALLOW_THREADS;
+    for (auto &v : views) PyBuffer_Release(&v);
+    return PyLong_FromSsize_t(rc);
+}
+
+/* flush_many(jobs) -> list[int]
+ * Batched submission: jobs is a sequence of (fd, buffers); every ready
+ * connection's pending chunks are flushed inside ONE GIL window — N
+ * writable peers cost one GIL round-trip, not N. Per-job result is
+ * bytes written or -errno (a dead peer must not fail its neighbours'
+ * flushes). */
+static PyObject *fastwire_flush_many(PyObject *self, PyObject *args) {
+    PyObject *jobs;
+    if (!PyArg_ParseTuple(args, "O", &jobs)) return NULL;
+    PyObject *fast = PySequence_Fast(jobs, "jobs must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t njobs = PySequence_Fast_GET_SIZE(fast);
+
+    struct JobIov {
+        int fd;
+        size_t viv_start, viv_len; /* slice into the shared views vector */
+        std::vector<struct iovec> iov;
+        ssize_t result;
+    };
+    std::vector<Py_buffer> views;
+    std::vector<JobIov> parsed;
+    parsed.reserve((size_t)njobs);
+    for (Py_ssize_t i = 0; i < njobs; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        int fd;
+        PyObject *bufseq;
+        if (!PyArg_ParseTuple(item, "iO", &fd, &bufseq)) {
+            for (auto &v : views) PyBuffer_Release(&v);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        JobIov j;
+        j.fd = fd;
+        j.viv_start = views.size();
+        if (collect_iov(bufseq, views, j.iov) < 0) {
+            for (auto &v : views) PyBuffer_Release(&v);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        j.viv_len = views.size() - j.viv_start;
+        j.result = 0;
+        parsed.push_back(std::move(j));
+    }
+
+    Py_BEGIN_ALLOW_THREADS;
+    for (auto &j : parsed) j.result = writev_nb(j.fd, j.iov);
+    Py_END_ALLOW_THREADS;
+
+    for (auto &v : views) PyBuffer_Release(&v);
+    Py_DECREF(fast);
+
+    PyObject *out = PyList_New(njobs);
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < njobs; i++) {
+        PyObject *v = PyLong_FromSsize_t(parsed[(size_t)i].result);
+        if (!v) {
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, v);
+    }
+    return out;
+}
+
+/* recv_into_nb(fd, writable_buffer) -> int
+ * Nonblocking drain: recv repeatedly into the buffer until it is full
+ * or the socket would block — one GIL window for the whole burst.
+ * Returns bytes read (0 = would block before any byte), -2 on EOF with
+ * nothing read this call, or -errno on a hard error with nothing read
+ * (partial reads return the partial count; the condition resurfaces on
+ * the next call). */
+static PyObject *fastwire_recv_into_nb(PyObject *self, PyObject *args) {
+    int fd;
+    Py_buffer buf;
+    if (!PyArg_ParseTuple(args, "iw*", &fd, &buf)) return NULL;
+
+    ssize_t got = 0, result = 0;
+    Py_BEGIN_ALLOW_THREADS;
+    char *p = (char *)buf.buf;
+    size_t n = (size_t)buf.len;
+    while ((size_t)got < n) {
+        ssize_t rc = recv(fd, p + got, n - (size_t)got, 0);
+        if (rc > 0) {
+            got += rc;
+            continue;
+        }
+        if (rc == 0) {
+            result = (got > 0) ? got : -2;
+            goto done;
+        }
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            result = got;
+            goto done;
+        }
+        result = (got > 0) ? got : -(ssize_t)errno;
+        goto done;
+    }
+    result = got;
+done:;
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&buf);
+    return PyLong_FromSsize_t(result);
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
 /* ------------------------------------------------------------------ */
 
@@ -704,6 +969,24 @@ static PyMethodDef fastwire_methods[] = {
      "recv_scatter(fd, timeout_ms, sizes) -> list of pooled buffers."},
     {"pool_trim", fastwire_pool_trim, METH_NOARGS,
      "pool_trim(): free every idle pooled receive block."},
+    {"reactor_new", fastwire_reactor_new, METH_NOARGS,
+     "reactor_new() -> epoll fd (close-on-exec)."},
+    {"reactor_close", fastwire_reactor_close, METH_VARARGS,
+     "reactor_close(epfd): close the epoll fd."},
+    {"reactor_ctl", fastwire_reactor_ctl, METH_VARARGS,
+     "reactor_ctl(epfd, op, fd, events): EPOLL_CTL_{ADD=1,DEL=2,MOD=3}."},
+    {"reactor_wait", fastwire_reactor_wait, METH_VARARGS,
+     "reactor_wait(epfd, timeout_ms) -> [(fd, events)] in one "
+     "GIL-released epoll_wait."},
+    {"sendv_nb", fastwire_sendv_nb, METH_VARARGS,
+     "sendv_nb(fd, buffers) -> bytes written (0 on EAGAIN, -errno on "
+     "error); one nonblocking writev batch."},
+    {"flush_many", fastwire_flush_many, METH_VARARGS,
+     "flush_many([(fd, buffers), ...]) -> [bytes|-errno]: flush many "
+     "connections' send rings in one GIL window."},
+    {"recv_into_nb", fastwire_recv_into_nb, METH_VARARGS,
+     "recv_into_nb(fd, buffer) -> bytes read (0 would-block, -2 EOF, "
+     "-errno error); drains a burst in one GIL window."},
     {NULL, NULL, 0, NULL},
 };
 

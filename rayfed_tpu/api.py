@@ -311,6 +311,18 @@ def init(
     # starts last, its heartbeats riding the same (possibly injected)
     # sender, so a partitioned link takes the heartbeats down with the
     # data.
+    # Aggregation topology default (rayfed_tpu/topology.py): every driver
+    # reads the same config, so every party plans the identical reduction
+    # DAG (multi-controller contract).
+    aggregation_dict = config.get("aggregation") or {}
+    if aggregation_dict:
+        from rayfed_tpu import topology as _topology
+
+        _topology.set_default(
+            aggregation_dict.get("topology", "auto"),
+            group_size=aggregation_dict.get("group_size"),
+        )
+
     resilience_dict = config.get("resilience") or {}
     if resilience_dict and party_process_id == 0:
         from rayfed_tpu.resilience import inject as _inject
@@ -383,6 +395,9 @@ def _shutdown(intended: bool = True):
 
     _liveness.stop_monitor()
     _inject.uninstall()
+    from rayfed_tpu import topology as _topology
+
+    _topology.reset_default()
     barriers.stop_proxies(job_name=ctx.get_job_name())
     # Only touch the collective lane if it was ever imported — keeps jax
     # out of control-plane-only processes.

@@ -262,6 +262,16 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
             (plaintext) sender lane; bounds resend memory at
             window x payload size. 1 degenerates to half-duplex
             request-response.
+        use_reactor: drive plaintext connections from the shared epoll
+            reactor loop(s) instead of per-peer reader/writer threads
+            (default True where epoll exists). The wire protocol, ack
+            semantics, and failure envelope are identical; only the
+            threading model changes. TLS connections always use the
+            threaded half-duplex path regardless.
+        num_reactors: size of the process-wide reactor thread pool that
+            connections are distributed over. One loop comfortably
+            drives tens of peers; raise it only when a single reactor
+            core saturates.
     """
 
     retry_policy: Optional[Dict[str, Any]] = None
@@ -270,6 +280,8 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
     per_party_config: Optional[Dict[str, Dict[str, Any]]] = None
     proxy_max_restarts: int = 3
     send_window: int = 8
+    use_reactor: bool = True
+    num_reactors: int = 1
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)

@@ -19,11 +19,16 @@ these helpers package the standard patterns while preserving the engine's
 semantics — every helper builds an ordinary fed DAG, so the owner-push
 perimeter, seq-id determinism, and error envelopes all apply unchanged.
 
-``fed_aggregate`` reduces per-party FedObjects with a **pairwise
-hierarchical tree** (BASELINE.json config #4): with n parties the reduction
-runs in ceil(log2 n) rounds of 2-way jitted reduces, halving the
-coordinator's fan-in (and its inbound bandwidth) versus the naive
-all-to-coordinator star.
+``fed_aggregate`` reduces per-party FedObjects along a **planned
+reduction topology** (``rayfed_tpu/topology.py``): flat star, binary
+tree, ring chain, or hierarchical edge-aggregator fan-in, selected per
+call (``topology=``) or job-wide (``config['aggregation']['topology']``,
+default ``auto``). Each plan step is one k-ary jitted reduce executing at
+the step's destination party, so the communication shape — rounds,
+per-node fan-in, per-link traffic — is exactly the planner's schedule.
+Degraded rounds re-plan over survivors: pass ``liveness=`` (the
+``fed.liveness_view()`` dict) and DEAD parties are excluded before the
+schedule is laid out.
 """
 
 from __future__ import annotations
@@ -31,22 +36,26 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Sequence
 
 import rayfed_tpu as fed
+from rayfed_tpu import topology as topo
 
 
 @fed.remote
-def _agg_pair_sum(a, b):
+def _agg_kary_sum(*trees):
     from rayfed_tpu.ops.aggregate import tree_sum
 
-    return tree_sum(a, b)
+    return tree_sum(*trees)
 
 
 @fed.remote
-def _agg_pair_weighted(a, b):
-    # a, b: (tree, weight) pairs; returns (weighted-sum tree, total weight).
+def _agg_kary_weighted(*pairs):
+    # pairs: (tree, weight) partials; returns (weighted-sum tree, total).
     from rayfed_tpu.ops.aggregate import tree_sum
 
-    (ta, wa), (tb, wb) = a, b
-    return tree_sum(ta, tb), wa + wb
+    trees = [t for t, _ in pairs]
+    total = pairs[0][1]
+    for _, w in pairs[1:]:
+        total = total + w
+    return tree_sum(*trees), total
 
 
 @fed.remote
@@ -75,53 +84,80 @@ def fed_aggregate(
     objs: Dict[str, Any],
     op: str = "mean",
     weights: Optional[Dict[str, float]] = None,
+    topology: Optional[str] = None,
+    liveness: Optional[Dict[str, str]] = None,
+    plan: Optional[topo.TopologyPlan] = None,
 ) -> Any:
-    """Reduce ``{party: FedObject-of-pytree}`` hierarchically.
+    """Reduce ``{party: FedObject-of-pytree}`` along a planned topology.
 
-    The result lives at the first party (tree root); pass it to
-    ``fed.get`` to broadcast, or feed it onwards in the DAG. All parties
-    must call this with the same arguments (multi-controller contract).
+    The result lives at the plan's root (the first surviving party);
+    pass it to ``fed.get`` to broadcast, or feed it onwards in the DAG.
+    All parties must call this with the same arguments
+    (multi-controller contract — the plan is a pure function of them, so
+    every driver lays out the identical DAG).
 
     op: "sum", "mean", or "wmean" (sample-count weighting via ``weights``).
+    topology: "auto" | "flat" | "tree" | "ring" | "hier"; None reads the
+        job default set by ``config['aggregation']['topology']``.
+    liveness: a ``fed.liveness_view()``-shaped ``{party: state}`` dict;
+        DEAD parties are dropped and the schedule re-planned over the
+        survivors (their FedObjects are never consumed, "mean" divides by
+        the survivor count).
+    plan: a pre-computed :class:`~rayfed_tpu.topology.TopologyPlan` —
+        overrides ``topology``/``liveness`` (callers that already
+        re-planned mid-round pass the new plan directly).
     """
     assert objs, "need at least one party's object"
-    parties = list(objs.keys())
+    if plan is None:
+        default_topo, group_size = topo.get_default()
+        dead = set()
+        if liveness:
+            from rayfed_tpu.resilience.liveness import DEAD
+
+            dead = {p for p, st in liveness.items() if st == DEAD}
+        plan = topo.plan(
+            list(objs.keys()),
+            topology or default_topo,
+            group_size=group_size,
+            dead=dead,
+        )
+    missing = set(plan.parties) - set(objs)
+    if missing:
+        raise ValueError(
+            f"plan references parties with no contribution: {sorted(missing)}"
+        )
+
     if op == "wmean":
         assert weights is not None, "op='wmean' needs weights={party: w}"
-        missing = set(parties) - set(weights)
-        if missing:
+        missing_w = set(plan.parties) - set(weights)
+        if missing_w:
             raise ValueError(
                 f"op='wmean' weights missing entries for parties "
-                f"{sorted(missing)}"
+                f"{sorted(missing_w)}"
             )
-        level = [
-            _premul.party(p).remote(objs[p], float(weights[p]))
-            for p in parties
-        ]
-        reducer = _agg_pair_weighted
+        held = {
+            p: _premul.party(p).remote(objs[p], float(weights[p]))
+            for p in plan.parties
+        }
+        reducer = _agg_kary_weighted
     else:
         assert op in ("sum", "mean"), op
-        level = [objs[p] for p in parties]
-        reducer = _agg_pair_sum
-    owners = list(parties)
+        held = {p: objs[p] for p in plan.parties}
+        reducer = _agg_kary_sum
 
-    # ceil(log2 n) rounds of pairwise reduces; each reduce executes at the
-    # left operand's owner, so traffic per round is one push per pair.
-    while len(level) > 1:
-        nxt, nxt_owners = [], []
-        for i in range(0, len(level) - 1, 2):
-            nxt.append(
-                reducer.party(owners[i]).remote(level[i], level[i + 1])
+    # Walk the schedule: each step is one k-ary reduce executing at the
+    # step's destination, folding in the plan's explicit src order.
+    for level in plan.levels:
+        for step in level:
+            held[step.dst] = reducer.party(step.dst).remote(
+                *[held[s] for s in step.srcs]
             )
-            nxt_owners.append(owners[i])
-        if len(level) % 2:
-            nxt.append(level[-1])
-            nxt_owners.append(owners[-1])
-        level, owners = nxt, nxt_owners
+            for s in step.srcs[1:]:
+                del held[s]
 
-    root, root_owner = level[0], owners[0]
+    root, root_owner = held[plan.root], plan.root
     if op == "mean":
-        return _scale.party(root_owner).remote(root, float(len(parties)))
+        return _scale.party(root_owner).remote(root, float(len(plan.parties)))
     if op == "wmean":
         return _scale_weighted.party(root_owner).remote(root)
     return root
@@ -144,10 +180,12 @@ class FedAvgTrainer:
         worker_args: Optional[Dict[str, tuple]] = None,
         op: str = "mean",
         weights: Optional[Dict[str, float]] = None,
+        topology: Optional[str] = None,
     ):
         self._parties = list(parties)
         self._op = op
         self._weights = weights
+        self._topology = topology
         worker_args = worker_args or {}
         self._workers = {
             p: worker_cls.party(p).remote(*worker_args.get(p, ()))
@@ -167,6 +205,7 @@ class FedAvgTrainer:
                 for p in self._parties
             }
             global_params = fed_aggregate(
-                locals_, op=self._op, weights=self._weights
+                locals_, op=self._op, weights=self._weights,
+                topology=self._topology,
             )
         return global_params

@@ -96,11 +96,59 @@ def tree_weighted_mean(trees, weights, acc_dtype: Optional[str] = "float32"):
     return _tree_weighted_mean(tuple(trees), tuple(weights), acc_dtype=acc_dtype)
 
 
+def reduce_by_plan(
+    plan,
+    contributions,
+    weights=None,
+    acc_dtype: Optional[str] = "float32",
+):
+    """Fold ``{party: tree}`` following a
+    :class:`~rayfed_tpu.topology.TopologyPlan`'s exact association order.
+
+    This is the local-execution twin of ``fed_aggregate``'s distributed
+    lowering: each plan step k-ary-folds its ``srcs`` partials (weighted:
+    premultiplied trees + running weight totals), so the arithmetic — and
+    therefore the bits — matches what the wire topology produces. Used by
+    the scale bench and the bitwise-identity tests to compare topologies
+    without N processes, and by :func:`elastic_weighted_mean` when a
+    topology is requested.
+
+    Returns the weighted mean over ``plan.parties``.
+    """
+    missing = set(plan.parties) - set(contributions)
+    if missing:
+        raise ValueError(
+            f"plan references parties with no contribution: {sorted(missing)}"
+        )
+    held = {}
+    totals = {}
+    for p in plan.parties:
+        w = 1.0 if weights is None else weights[p]
+        held[p] = jax.tree_util.tree_map(
+            lambda x, w=w: x * w, contributions[p]
+        )
+        totals[p] = w
+    for level in plan.levels:
+        for step in level:
+            held[step.dst] = tree_sum(
+                *[held[s] for s in step.srcs], acc_dtype=acc_dtype
+            )
+            totals[step.dst] = sum(totals[s] for s in step.srcs)
+            for s in step.srcs[1:]:
+                del held[s], totals[s]
+    total = totals[plan.root]
+    return jax.tree_util.tree_map(
+        lambda x: x / total, held[plan.root]
+    )
+
+
 def elastic_weighted_mean(
     contributions,
     weights=None,
     liveness=None,
     acc_dtype: Optional[str] = "float32",
+    topology: Optional[str] = None,
+    group_size: Optional[int] = None,
 ):
     """Degraded-mode FedAvg: the weighted mean over SURVIVING
     contributors, re-normalized so the aggregate stays an average of what
@@ -122,6 +170,12 @@ def elastic_weighted_mean(
     Survivor fold order is party-name order, independent of which subset
     survived, so the same surviving set produces bitwise-identical
     aggregates on every party (the determinism contract above).
+
+    ``topology`` (None = the flat left-to-right fold above) folds along a
+    planned reduction shape instead — the plan is laid out over the
+    surviving set (a DEAD party re-plans the topology rather than
+    leaving a hole in it), and the association order matches what
+    ``fed_aggregate`` produces on the wire for the same survivors.
     """
     from rayfed_tpu.resilience.degraded import MISSING
     from rayfed_tpu.resilience.liveness import DEAD
@@ -137,6 +191,17 @@ def elastic_weighted_mean(
         raise ValueError(
             "no surviving contributors to aggregate: all values missing "
             "or their parties marked DEAD"
+        )
+    if topology is not None:
+        from rayfed_tpu import topology as topo
+
+        surv_plan = topo.plan(survivors, topology, group_size=group_size)
+        return reduce_by_plan(
+            surv_plan,
+            {p: contributions[p] for p in survivors},
+            weights=None if weights is None
+            else {p: weights[p] for p in survivors},
+            acc_dtype=acc_dtype,
         )
     trees = [contributions[p] for p in survivors]
     w = [1.0 if weights is None else weights[p] for p in survivors]

@@ -129,7 +129,10 @@ class GrpcSenderProxy(SenderProxy):
         ch = self._channels.get(dest_party)
         if ch is None:
             addr = self._addresses[dest_party]
-            options = _channel_options(self._config)
+            # Per-destination effective config: per_party_config overrides
+            # (message caps, retry policy) apply to the channel options,
+            # matching the TCP lane's for_dest behavior.
+            options = _channel_options(self._config.for_dest(dest_party))
             if self._tls_config:
                 ca, cert, key = _load_tls_files(self._tls_config)
                 creds = grpc.ssl_channel_credentials(

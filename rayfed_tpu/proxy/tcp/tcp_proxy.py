@@ -29,10 +29,15 @@ Capability parity with the reference's gRPC transport
 
 TPU-first differences: payloads ride the array fast path
 (``serialization.try_encode_tree``) — raw device bytes + a msgpack
-skeleton, no cloudpickle on the hot loop — and the data plane is blocking
-sockets on dedicated threads (one sender worker per destination, one reader
-thread per inbound connection), which sustains loopback/NIC line rate where
-event-loop streaming tops out ~20x lower (see sockio.py).
+skeleton, no cloudpickle on the hot loop — and plaintext connections are
+multiplexed over a small shared pool of epoll reactor threads
+(``proxy/tcp/reactor.py``; ``cross_silo_comm.num_reactors``), with the
+bulk byte work (batched ``writev`` flushes, scatter reads) done by the
+native fastwire engine. Per-peer dedicated threads survive only where
+they must: TLS connections (SSLSocket cannot be polled usefully through
+raw fds), the device-DMA lane, ``use_reactor: false``, and platforms
+without epoll — those keep one sender worker per destination and one
+reader thread per inbound connection.
 """
 
 from __future__ import annotations
@@ -63,10 +68,21 @@ from rayfed_tpu.proxy.base import (
     SenderReceiverProxy,
 )
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
+from rayfed_tpu.proxy.tcp import reactor as reactor_mod
 from rayfed_tpu.proxy.tcp import sockio, wire
 from rayfed_tpu.resilience.retry import Deadline, run_with_retry
 
 logger = logging.getLogger(__name__)
+
+
+def _reactor_mode(cfg, tls_config) -> bool:
+    """Plaintext connections ride the shared epoll reactor when the
+    platform has one; TLS keeps the threaded half-duplex path."""
+    return (
+        not wire.tls_enabled(tls_config)
+        and getattr(cfg, "use_reactor", True)
+        and reactor_mod.available()
+    )
 
 
 class _ConnectExhausted(Exception):
@@ -84,7 +100,13 @@ def _parse_addr(addr: str) -> Tuple[str, int]:
 class _DestWorker(threading.Thread):
     """Owns the persistent connection to one destination party and executes
     its send jobs in order (the reference serializes per-dest sends on one
-    channel the same way)."""
+    channel the same way).
+
+    In reactor mode the thread NEVER STARTS: jobs are prepared on the
+    submitting thread (or on the thread that completes the value future)
+    and handed straight to the reactor-owned lane — no per-peer worker
+    hop, no per-peer thread. The thread body only runs for the TLS
+    half-duplex path and the device-DMA lane."""
 
     def __init__(self, proxy: "TcpSenderProxy", dest_party: str):
         super().__init__(name=f"fedtpu-send-{dest_party}", daemon=True)
@@ -99,19 +121,17 @@ class _DestWorker(threading.Thread):
         self._small_threshold = max(
             0, getattr(self._cfg, "small_message_threshold", 0) or 0
         )
+        use_reactor = _reactor_mode(self._cfg, proxy._tls_config)
         if not wire.tls_enabled(proxy._tls_config):
             # Plaintext connections pipeline frames (window of unacked
             # sends); TLS keeps half-duplex request-response because
             # ssl.SSLSocket cannot be read and written concurrently.
-            from rayfed_tpu.proxy.tcp.pipeline import PipelinedLane
-
             policy = self._cfg.get_retry_policy()
 
             def bump_acks() -> None:
                 proxy._bump_stat("send_op_count")
 
-            self._lane = PipelinedLane(
-                dest_party,
+            lane_kwargs = dict(
                 connect=lambda attempts: self._fresh_sock(attempts),
                 max_attempts=policy.max_attempts,
                 ack_timeout_s=self._cfg.timeout_in_ms / 1000,
@@ -119,14 +139,62 @@ class _DestWorker(threading.Thread):
                 window=self._cfg.send_window,
                 small_threshold=self._small_threshold,
             )
-        self.start()
+            if use_reactor:
+                self._lane = reactor_mod.ReactorLane(
+                    dest_party,
+                    reactor=proxy._reactor_for(dest_party),
+                    **lane_kwargs,
+                )
+            else:
+                from rayfed_tpu.proxy.tcp.pipeline import PipelinedLane
+
+                self._lane = PipelinedLane(dest_party, **lane_kwargs)
+        # The device-DMA lane's register step is not vetted for arbitrary
+        # submitter threads, so it keeps the serialized worker.
+        self._threaded = self._lane is None or not use_reactor or bool(
+            getattr(self._cfg, "device_dma", False)
+        )
+        if self._threaded:
+            self.start()
 
     def submit(self, job) -> None:
-        self._jobs.put(job)
+        if self._threaded:
+            self._jobs.put(job)
+            return
+        out, data, *_ = job
+        if isinstance(data, Future) and not data.done():
+            # Finish on whichever thread completes the value — the
+            # executor worker that produced it, usually. The send stays
+            # ordered per edge because every (up, down) pair is a unique
+            # rendezvous key.
+            data.add_done_callback(lambda _f, j=job: self._run_job_inline(j))
+            return
+        self._run_job_inline(job)
+
+    def _run_job_inline(self, job) -> None:
+        """Reactor-mode job dispatch: prepare + lane-submit with the same
+        error envelope as the threaded drain loop, minus the queue hop."""
+        out, data, upstream_seq_id, downstream_seq_id, is_error = job
+        if self._closed:
+            if not out.done():
+                out.set_exception(ConnectionError("sender stopped"))
+            return
+        try:
+            header, buffers, payload_len, on_done = self._prepare(
+                data, upstream_seq_id, downstream_seq_id, is_error
+            )
+        except BaseException as e:  # noqa: BLE001 - routed to drain
+            out.set_exception(e)
+            return
+        self._attach_done_callbacks(
+            out, on_done, payload_len, upstream_seq_id, downstream_seq_id
+        )
+        self._lane.submit(out, header, buffers, payload_len)
 
     def close(self) -> None:
         self._closed = True
-        self._jobs.put(None)
+        if self._threaded:
+            self._jobs.put(None)
         if self._lane is not None:
             self._lane.close()
 
@@ -488,6 +556,20 @@ class TcpSenderProxy(SenderProxy):
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._stats = {"send_op_count": 0}
+        self._reactors = None  # lazily acquired pool refs (reactor mode)
+        self._reactor_lock = threading.Lock()
+
+    def _reactor_for(self, dest_party: str):
+        """A reactor from the shared pool for this destination's lane —
+        peers are spread across the pool by stable hash so N parties load
+        ``num_reactors`` loops evenly."""
+        with self._reactor_lock:
+            if self._reactors is None:
+                self._reactors = reactor_mod.acquire_reactors(
+                    max(1, getattr(self._config, "num_reactors", 1))
+                )
+            rs = self._reactors
+        return rs[hash(dest_party) % len(rs)]
 
     def _try_encode_special(self, value, is_error: bool, cfg):
         """Subclass hook: divert a payload to an alternate lane. Returns
@@ -534,6 +616,10 @@ class TcpSenderProxy(SenderProxy):
             self._workers.clear()
         for w in workers:
             w.close()
+        with self._reactor_lock:
+            had_ref, self._reactors = self._reactors is not None, None
+        if had_ref:
+            reactor_mod.release_reactors()
 
 
 class TcpReceiverProxy(ReceiverProxy):
@@ -553,6 +639,11 @@ class TcpReceiverProxy(ReceiverProxy):
         self._open_conns: set = set()
         self._conn_lock = threading.Lock()
         self._stopping = False
+        # Reactor mode: ONE supervised accept thread remains (accept is
+        # cheap and blocking-friendly); the per-connection serve threads
+        # are replaced by ServerConnection handlers on the shared loops.
+        self._reactors = None
+        self._next_reactor = 0
 
     def _make_decode_fn(self):
         """Hook: the TPU receiver overrides this to add device placement."""
@@ -581,6 +672,10 @@ class TcpReceiverProxy(ReceiverProxy):
             )
             return
         self._ready_result = (True, None)
+        if _reactor_mode(self._config, self._tls_config):
+            self._reactors = reactor_mod.acquire_reactors(
+                max(1, getattr(self._config, "num_reactors", 1))
+            )
         threading.Thread(
             target=self._accept_loop,
             name=f"fedtpu-recv-accept-{self._party}",
@@ -621,6 +716,9 @@ class TcpReceiverProxy(ReceiverProxy):
                 c.close()
             except OSError:
                 pass
+        if self._reactors is not None:
+            self._reactors = None
+            reactor_mod.release_reactors()
         self._store.shutdown()
         # A burst of large frames must not pin pool memory past the job.
         sockio.trim_recv_pool()
@@ -677,12 +775,42 @@ class TcpReceiverProxy(ReceiverProxy):
                 # Unexpected accept failure (EMFILE/ENOBUFS/...): let the
                 # supervisor restart the listener instead of going deaf.
                 raise
+            if ssl_ctx is None and self._reactors is not None:
+                self._serve_conn_reactor(conn, peer)
+                continue
             threading.Thread(
                 target=self._serve_conn,
                 args=(conn, peer, ssl_ctx),
                 name=f"fedtpu-recv-conn-{peer}",
                 daemon=True,
             ).start()
+
+    def _serve_conn_reactor(self, conn: socket.socket, peer) -> None:
+        """Hand one plaintext inbound connection to a reactor loop
+        (round-robin across the pool). RESP acks ride the connection's
+        send ring and flush once per poll batch — same piggybacking
+        contract as the threaded path's _ACK_FLUSH_MAX batching."""
+        def on_close(handler) -> None:
+            with self._conn_lock:
+                self._open_conns.discard(handler)
+
+        try:
+            sockio.tune_socket(conn)
+            r = self._reactors[self._next_reactor % len(self._reactors)]
+            self._next_reactor += 1
+            handler = reactor_mod.ServerConnection(
+                r, conn, peer, self._store.offer, on_close=on_close,
+                max_payload=self._config.effective_max_message_bytes(),
+            )
+        except OSError as e:
+            logger.warning("receiver connection from %s failed: %s", peer, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._conn_lock:
+            self._open_conns.add(handler)
 
     # Hard flush bound for batched acks. Deliberately above the default
     # send window (8): a sender stalls only when its window fills, which

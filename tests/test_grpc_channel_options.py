@@ -1,0 +1,100 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""gRPC channel-option audit tests.
+
+gRPC core hard-caps ``retryPolicy.maxAttempts`` at 5 and prints
+``retry_service_config.cc: Clamped retryPolicy.maxAttempts at 5`` to
+stderr on EVERY channel build that asks for more — noise that buries real
+warnings in multi-party runs. The contract checked here: no service
+config this codebase renders ever requests more than 5 attempts, for any
+retry configuration, including per-destination overrides. (The
+engine-level retry loop still honors the full configured count; only the
+gRPC-core rendering is clamped.)
+"""
+
+import json
+
+import pytest
+
+from rayfed_tpu.config import TcpCrossSiloMessageConfig
+
+grpc_proxy = pytest.importorskip("rayfed_tpu.proxy.grpc.grpc_proxy")
+
+
+def _service_config(options):
+    payload = dict(options).get("grpc.service_config")
+    assert payload is not None, "channel options carry no service config"
+    return json.loads(payload)
+
+
+def _max_attempts_rendered(cfg):
+    sc = _service_config(grpc_proxy._channel_options(cfg))
+    attempts = [
+        mc["retryPolicy"]["maxAttempts"]
+        for mc in sc["methodConfig"]
+        if "retryPolicy" in mc
+    ]
+    assert attempts, "service config renders no retryPolicy"
+    return max(attempts)
+
+
+@pytest.mark.parametrize("configured", [1, 2, 5, 6, 20, 1000])
+def test_service_config_never_requests_more_than_five_attempts(configured):
+    cfg = TcpCrossSiloMessageConfig.from_dict(
+        {"retry_policy": {"max_attempts": configured}}
+    )
+    rendered = _max_attempts_rendered(cfg)
+    assert 2 <= rendered <= 5, (configured, rendered)
+
+
+def test_per_dest_overrides_stay_clamped():
+    cfg = TcpCrossSiloMessageConfig.from_dict(
+        {
+            "retry_policy": {"max_attempts": 3},
+            "per_party_config": {
+                "bob": {"retry_policy": {"max_attempts": 50}},
+            },
+        }
+    )
+    # The override path _get_channel takes: for_dest applies the
+    # per-party retry policy, and the rendering must still pre-clamp.
+    assert _max_attempts_rendered(cfg.for_dest("bob")) == 5
+    assert _max_attempts_rendered(cfg.for_dest("alice")) == 3
+
+
+def test_per_dest_message_cap_reaches_channel_options():
+    cfg = TcpCrossSiloMessageConfig.from_dict(
+        {
+            "messages_max_size_in_bytes": 1000,
+            "per_party_config": {
+                "bob": {"messages_max_size_in_bytes": 2000},
+            },
+        }
+    )
+    bob = dict(grpc_proxy._channel_options(cfg.for_dest("bob")))
+    other = dict(grpc_proxy._channel_options(cfg.for_dest("alice")))
+    assert bob["grpc.max_receive_message_length"] == 2000
+    assert other["grpc.max_receive_message_length"] == 1000
+
+
+def test_retries_enabled_and_status_codes_scoped():
+    cfg = TcpCrossSiloMessageConfig.from_dict({})
+    options = dict(grpc_proxy._channel_options(cfg))
+    assert options["grpc.enable_retries"] == 1
+    sc = _service_config(options)
+    for mc in sc["methodConfig"]:
+        # Only transient transport failures retry at the channel layer;
+        # application errors surface to the engine's own retry loop.
+        assert mc["retryPolicy"]["retryableStatusCodes"] == ["UNAVAILABLE"]

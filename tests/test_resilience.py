@@ -593,6 +593,19 @@ def run_chaos_party(party, addresses, seed, trace_path):
             np.asarray(agg["w"]), np.full((4,), num / den, np.float32),
             rtol=1e-6,
         )
+        # Same surviving set, same bits, regardless of reduction shape:
+        # tree and ring lay their schedule out over the survivors (a
+        # DEAD party never appears in the plan at all), and the
+        # integer-valued float32 updates make every partial sum exact,
+        # so the planned folds must reproduce the flat aggregate byte
+        # for byte even while parties are dropping.
+        for shape in ("tree", "ring"):
+            shaped = elastic_weighted_mean(
+                contribs, weights=CHAOS_WEIGHTS, liveness=view,
+                topology=shape,
+            )
+            assert np.asarray(shaped["w"]).tobytes() == \
+                np.asarray(agg["w"]).tobytes(), shape
         if r == CHAOS_ROUNDS - 1:
             if party == "alice":
                 assert "bob" not in survivors, (survivors, view)
@@ -631,3 +644,31 @@ def test_chaos_fedavg_two_party_deterministic(tmp_path):
     # The partition rule (index 0) must have fired on the post-cut frames.
     assert any(e["fault"] == "partition" for e in parsed), parsed
     assert traces[0] == traces[1], "same seed must replay bit-for-bit"
+
+
+def test_topology_replan_when_party_dies_mid_round():
+    """A party that goes DEAD after the reduction schedule was laid out
+    but before the round ran: the driver re-plans over the survivors
+    (the dead party never appears as a reduce destination — no subtree
+    wedges on it) and the re-run round produces the survivors' mean."""
+    from rayfed_tpu import topology as topo
+    from rayfed_tpu.ops.aggregate import reduce_by_plan
+
+    parties = [f"p{i}" for i in range(6)]
+    contribs = {
+        p: {"w": np.full((8,), float(i + 1), np.float32)}
+        for i, p in enumerate(parties)
+    }
+    expect = np.mean([i + 1 for i in range(6) if i != 3])
+    for shape in ("tree", "ring", "hier"):
+        old = topo.plan(parties, shape)
+        assert any(
+            "p3" in (step.dst, *step.srcs)
+            for lvl in old.levels for step in lvl
+        )
+        new = topo.replan(old, dead={"p3"})
+        new.validate()
+        assert "p3" not in new.parties
+        assert new.root == old.root  # surviving root keeps ownership
+        out = reduce_by_plan(new, {p: contribs[p] for p in new.parties})
+        np.testing.assert_allclose(np.asarray(out["w"]), expect)

@@ -1,0 +1,178 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Topology planner unit tests: plan shapes, validation, re-planning on
+DEAD parties, and the bitwise-identity contract of ``reduce_by_plan``
+across topologies."""
+
+import numpy as np
+import pytest
+
+from rayfed_tpu import topology as topo
+from rayfed_tpu.ops.aggregate import elastic_weighted_mean, reduce_by_plan
+
+CONCRETE = ("flat", "tree", "ring", "hier")
+
+
+def _parties(n):
+    return [f"p{i:02d}" for i in range(n)]
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 8, 9, 16, 33, 64])
+@pytest.mark.parametrize("shape", CONCRETE)
+def test_plans_validate_for_all_shapes(n, shape):
+    p = topo.plan(_parties(n), shape)
+    p.validate()  # consumed-exactly-once + root-sole-holder
+    assert p.root == "p00"
+    assert p.parties == tuple(_parties(n))
+    assert p.topology == shape
+
+
+def test_shape_properties():
+    n = 16
+    flat = topo.plan(_parties(n), "flat")
+    assert flat.num_rounds == 1 and flat.max_fan_in == n - 1
+    tree = topo.plan(_parties(n), "tree")
+    assert tree.num_rounds == 4 and tree.max_fan_in == 1
+    ring = topo.plan(_parties(n), "ring")
+    assert ring.num_rounds == n - 1 and ring.max_fan_in == 1
+    # One transfer per ring round: each link carries exactly one model.
+    assert all(len(lvl) == 1 for lvl in ring.levels)
+    hier = topo.plan(_parties(n), "hier")
+    assert hier.num_rounds == 2
+    assert hier.max_fan_in <= 4  # group_size defaults to ceil(sqrt(16))
+
+
+def test_auto_resolution():
+    assert topo.plan(_parties(2), "auto").topology == "flat"
+    assert topo.plan(_parties(5), "auto").topology == "tree"
+    assert topo.plan(_parties(9), "auto").topology == "hier"
+    assert topo.resolve_auto(64) == "hier"
+
+
+def test_single_party_plan_is_empty():
+    for shape in CONCRETE:
+        p = topo.plan(["solo"], shape)
+        assert p.levels == () and p.root == "solo"
+
+
+def test_dead_parties_dropped_before_shaping():
+    p = topo.plan(_parties(8), "tree", dead={"p00", "p03"})
+    assert "p00" not in p.parties and "p03" not in p.parties
+    assert p.root == "p01"
+    p.validate()
+    with pytest.raises(ValueError, match="no surviving parties"):
+        topo.plan(_parties(2), "flat", dead=set(_parties(2)))
+
+
+def test_replan_keeps_surviving_root():
+    old = topo.plan(_parties(8), "hier")
+    new = topo.replan(old, dead={"p05"})
+    assert new.root == old.root and "p05" not in new.parties
+    new.validate()
+    # Root died: first survivor takes over.
+    new2 = topo.replan(old, dead={"p00"})
+    assert new2.root == "p01"
+    new2.validate()
+
+
+def test_explicit_root_moves_to_front():
+    p = topo.plan(_parties(6), "ring", root="p04")
+    assert p.root == "p04" and p.parties[0] == "p04"
+    p.validate()
+
+
+def test_malformed_step_rejected():
+    with pytest.raises(ValueError, match="must start with dst"):
+        topo.ReduceStep("a", ("b", "a"))
+    with pytest.raises(ValueError, match="unknown topology"):
+        topo.plan(_parties(3), "mesh")
+
+
+def test_default_roundtrip():
+    try:
+        topo.set_default("ring", group_size=4)
+        assert topo.get_default() == ("ring", 4)
+        with pytest.raises(ValueError, match="group_size"):
+            topo.set_default("hier", group_size=1)
+        with pytest.raises(ValueError, match="topology"):
+            topo.set_default("star")
+    finally:
+        topo.reset_default()
+    assert topo.get_default() == ("auto", None)
+
+
+def _int_contribs(n, shape=(64,)):
+    """Integer-valued float32 trees: float sums are exact, so every
+    association order produces the same bits (the cross-topology
+    identity contract from the module docstring)."""
+    return {
+        p: {"w": np.full(shape, float(i + 1), np.float32),
+            "b": np.arange(8, dtype=np.float32) * (i + 1)}
+        for i, p in enumerate(_parties(n))
+    }
+
+
+@pytest.mark.parametrize("n", [4, 9, 16])
+def test_reduce_by_plan_bitwise_identical_across_topologies(n):
+    contribs = _int_contribs(n)
+    ref = None
+    for shape in CONCRETE:
+        out = reduce_by_plan(topo.plan(_parties(n), shape), contribs)
+        if ref is None:
+            ref = out
+        else:
+            for k in ref:
+                assert np.asarray(out[k]).tobytes() == \
+                    np.asarray(ref[k]).tobytes(), shape
+    # And the value is right: mean of 1..n over leaf "w".
+    expect = sum(range(1, n + 1)) / n
+    assert float(np.asarray(ref["w"])[0]) == expect
+
+
+def test_reduce_by_plan_weighted_matches_flat():
+    n = 9
+    contribs = _int_contribs(n)
+    weights = {p: float(2 + i % 3) for i, p in enumerate(_parties(n))}
+    ref = reduce_by_plan(topo.plan(_parties(n), "flat"), contribs, weights)
+    for shape in ("tree", "ring", "hier"):
+        out = reduce_by_plan(
+            topo.plan(_parties(n), shape), contribs, weights
+        )
+        for k in ref:
+            assert np.asarray(out[k]).tobytes() == \
+                np.asarray(ref[k]).tobytes(), shape
+
+
+def test_reduce_by_plan_missing_contribution_rejected():
+    p = topo.plan(_parties(4), "tree")
+    contribs = _int_contribs(3)
+    with pytest.raises(ValueError, match="no contribution"):
+        reduce_by_plan(p, contribs)
+
+
+def test_elastic_weighted_mean_replans_over_survivors():
+    from rayfed_tpu.resilience.liveness import DEAD
+
+    n = 8
+    contribs = _int_contribs(n)
+    liveness = {"p02": DEAD}
+    flat = elastic_weighted_mean(contribs, liveness=liveness)
+    for shape in ("tree", "ring", "hier"):
+        out = elastic_weighted_mean(
+            contribs, liveness=liveness, topology=shape
+        )
+        for k in flat:
+            assert np.asarray(out[k]).tobytes() == \
+                np.asarray(flat[k]).tobytes(), shape

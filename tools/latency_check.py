@@ -33,6 +33,17 @@ Budget (ms per federated task):
   FEDTPU_TINY_REPS        default 3; the BEST repetition is compared —
                           the gate asks "can the code still go this
                           fast", not "was the host busy".
+
+Also gates the hierarchical-aggregation round (the bench's hier4 key was
+historically noisy because a single straggler round skewed the mean; the
+bench now reports the MEDIAN round with a [min, max] spread, and this
+gate compares the median) via an in-process 4-party simulated round:
+
+  FEDTPU_HIER4_BUDGET_MS  default 20.0 — budget on the median 4-party
+                          hierarchical round (measured ~2 ms on the
+                          1-core CI host class). 0 disables the gate.
+  FEDTPU_HIER4_ROUNDS     default 12 rounds per repetition; best
+                          repetition's median is compared, like tiny.
 """
 
 from __future__ import annotations
@@ -76,6 +87,35 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+
+    hier_budget_ms = float(os.environ.get("FEDTPU_HIER4_BUDGET_MS", "20.0"))
+    if hier_budget_ms > 0:
+        hier_rounds = int(os.environ.get("FEDTPU_HIER4_ROUNDS", "12"))
+        medians = []
+        for rep in range(reps):
+            res = bench._simulated_hier_round(4, hier_rounds)
+            medians.append(res["round_ms_median"])
+            print(
+                f"hier4 rep {rep + 1}/{reps}: "
+                f"median={medians[-1]:.2f} ms "
+                f"spread={[round(x, 2) for x in res['round_ms_spread']]}",
+                flush=True,
+            )
+        best_hier = min(medians)
+        print(f"hier4: best median {best_hier:.2f} ms "
+              f"(budget {hier_budget_ms:.2f})")
+        if best_hier > hier_budget_ms:
+            print(
+                f"LATENCY REGRESSION: hier4 round median {best_hier:.2f} "
+                f"exceeds the {hier_budget_ms:.2f} ms budget across all "
+                f"{reps} repetitions (median gating — a single straggler "
+                f"round cannot trip this; a systematic slowdown on the "
+                f"reactor transport or the hierarchical plan can). "
+                f"medians={medians}",
+                file=sys.stderr,
+            )
+            return 1
+
     print("latency gate passed")
     return 0
 

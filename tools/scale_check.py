@@ -1,0 +1,112 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Scale regression gate for N-party hierarchical aggregation.
+
+Runs bench.py's in-process simulated hierN rounds (real TCP proxies,
+real frames and acks over shared reactors — only the party *processes*
+are simulated) for N=8 and N=16 parties and FAILS LOUDLY — exit code
+1 — when the median round time exceeds its budget. Wire this into CI so
+a change that quietly serializes the reactor event loop, re-adds a
+per-peer thread hop, or breaks plan-level fan-out turns the build red.
+
+Gating is on the MEDIAN round over the best repetition: the gate asks
+"can the code still go this fast", not "was the shared runner busy".
+A total wall-clock budget bounds the whole check so a hang (a lost
+wakeup, a stuck dial) fails fast instead of eating the CI job timeout.
+
+Budgets (generous ~10x vs the ~3/6 ms medians measured on the 1-core
+CI host class, so host noise does not flake the gate, while a lost
+event loop — back to per-peer threads ≈ 2 threads x N parties — still
+trips it; tighten on dedicated hardware):
+
+  FEDTPU_SCALE_BUDGET8_MS    default 30.0 — 8-party round median budget.
+  FEDTPU_SCALE_BUDGET16_MS   default 60.0 — 16-party round median budget.
+  FEDTPU_SCALE_ROUNDS        default 12 rounds per repetition.
+  FEDTPU_SCALE_REPS          default 2; the best repetition's median is
+                             compared.
+  FEDTPU_SCALE_WALL_BUDGET_S default 300 — hard cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+_BUDGETS = {
+    8: ("FEDTPU_SCALE_BUDGET8_MS", 30.0),
+    16: ("FEDTPU_SCALE_BUDGET16_MS", 60.0),
+}
+
+
+def main() -> int:
+    rounds = int(os.environ.get("FEDTPU_SCALE_ROUNDS", "12"))
+    reps = int(os.environ.get("FEDTPU_SCALE_REPS", "2"))
+    wall_budget_s = float(os.environ.get("FEDTPU_SCALE_WALL_BUDGET_S", "300"))
+    t0 = time.monotonic()
+
+    failures = []
+    for n, (var, default) in _BUDGETS.items():
+        budget_ms = float(os.environ.get(var, str(default)))
+        medians = []
+        for rep in range(reps):
+            elapsed = time.monotonic() - t0
+            if elapsed > wall_budget_s:
+                print(
+                    f"SCALE GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed "
+                    f"exceeds the {wall_budget_s:.0f}s budget before the "
+                    f"check finished — a hung round or stuck dial, not "
+                    f"just a slow host.",
+                    file=sys.stderr,
+                )
+                return 1
+            res = bench._simulated_hier_round(n, rounds)
+            ms = res["round_ms_median"]
+            medians.append(ms)
+            print(
+                f"hier{n} rep {rep + 1}/{reps}: median={ms:.2f} ms "
+                f"spread={[round(x, 2) for x in res['round_ms_spread']]}",
+                flush=True,
+            )
+        best = min(medians)
+        print(f"hier{n}: best median {best:.2f} ms (budget {budget_ms:.2f})")
+        if best > budget_ms:
+            failures.append((n, best, budget_ms, medians))
+
+    if failures:
+        for n, best, budget_ms, medians in failures:
+            print(
+                f"SCALE REGRESSION: hier{n}_round_ms median {best:.2f} "
+                f"exceeds the {budget_ms:.2f} ms budget across all "
+                f"repetitions. The reactor transport is the usual suspect: "
+                f"check that plaintext lanes still ride the shared epoll "
+                f"reactors (cross_silo_comm.use_reactor), that acks still "
+                f"pump the pending queue, and that the topology planner "
+                f"still emits the hierarchical schedule. medians={medians}",
+                file=sys.stderr,
+            )
+        return 1
+    print(f"scale gate passed in {time.monotonic() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
