@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale clean
+.PHONY: native test lint chaos latency scale dma clean
 
 native:
 	python setup.py build_ext --inplace
@@ -40,6 +40,14 @@ latency:
 # .github/workflows/tests.yml.
 scale:
 	JAX_PLATFORMS=cpu python tools/scale_check.py
+
+# Data-plane gate: the striped multi-stream lane (num_streams reactor
+# lanes carrying stripe frames) must out-run the device-DMA lane's
+# CPU-sim throughput (FEDTPU_DMA_RATIO, default 1.0x) — a change that
+# serializes the stripe lanes or re-adds full-payload staging fails
+# loudly here.
+dma:
+	JAX_PLATFORMS=cpu python tools/dma_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -92,14 +92,34 @@ def _party_entry(target, party, *rest):
             import faulthandler
             import signal
 
+            from rayfed_tpu import tracing
+
+            # Span ring on: the hang artifact below needs per-seq-id
+            # send/recv/ack events to reconstruct which edge wedged.
+            tracing.enable()
+
+            def _dump_timeline(signum, frame):
+                # Python-level chained handler: best-effort (only runs
+                # when the main thread re-enters the interpreter loop);
+                # the C-level faulthandler stacks below always land.
+                try:
+                    tracing.export_timeline(
+                        os.path.join(d, f"{party}.timeline"), party
+                    )
+                except OSError:
+                    pass
+
+            signal.signal(signal.SIGUSR1, _dump_timeline)
             # Keep the file object referenced: faulthandler holds only
             # the fd, and a collected file object would close it.
             _party_entry._stacks_file = open(
                 os.path.join(d, f"{party}.stacks"), "w"
             )
+            # chain=True: the C handler dumps all-thread stacks first,
+            # then invokes the timeline handler installed above.
             faulthandler.register(
                 signal.SIGUSR1, file=_party_entry._stacks_file,
-                all_threads=True,
+                all_threads=True, chain=True,
             )
         except (OSError, ValueError, AttributeError):
             pass  # diagnostics must never fail the measurement
@@ -107,7 +127,7 @@ def _party_entry(target, party, *rest):
 
 
 def _party_main(party, addresses, transport, result_path, device_dma=False,
-                pair_ceiling=False):
+                pair_ceiling=False, num_streams=0, sharded=False):
     import numpy as np
 
     import rayfed_tpu as fed
@@ -117,6 +137,8 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
         comm["send_window"] = int(os.environ["FEDTPU_BENCH_WINDOW"])
     if device_dma:
         comm["device_dma"] = True
+    if num_streams:
+        comm["num_streams"] = num_streams
     fed.init(
         addresses=addresses,
         party=party,
@@ -141,6 +163,43 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
             return jax.block_until_ready(
                 jnp.full((n_elem,), float(i), dtype=jnp.float32)
             )
+    elif sharded:
+        # Sharded-pipeline lane: a 4-way sharded jax.Array (spawned under
+        # a forced multi-device CPU backend). The encode worker overlaps
+        # per-shard D2H and the stripe planner splits at shard extents,
+        # so the payload rides K lanes as parallel stripe frames.
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        nshards = min(4, len(jax.devices()))
+        sh = NamedSharding(
+            Mesh(np.array(jax.devices()[:nshards]), ("data",)),
+            PartitionSpec("data"),
+        )
+
+        @fed.remote
+        def produce(i):
+            import jax
+
+            return jax.block_until_ready(
+                jax.device_put(
+                    jnp.full((n_elem,), float(i), dtype=jnp.float32), sh
+                )
+            )
+    elif num_streams:
+        # Multi-leaf pytree: stripes split only at buffer (leaf/shard)
+        # boundaries, so one dense tensor cannot engage striping; 16
+        # chunks give the planner balanced extents for any lane count.
+        chunks = 16
+        per = n_elem // chunks
+
+        @fed.remote
+        def produce(i):
+            return [
+                np.full((per,), float(i), dtype=np.float32)
+                for _ in range(chunks)
+            ]
     else:
 
         @fed.remote
@@ -150,6 +209,14 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
 
     @fed.remote
     def consume(x):
+        if isinstance(x, (list, tuple)):
+            return float(x[0][0]) + float(x[-1][-1])
+        shards = list(getattr(x, "addressable_shards", None) or ())
+        if len(shards) > 1:
+            # Indexing a multi-device Array lowers to a cross-device
+            # gather; read the edge elements from single-device shards —
+            # the bench times the transport, not XLA dispatch.
+            return float(shards[0].data[0]) + float(shards[-1].data[-1])
         return float(x[0]) + float(x[-1])
 
     @fed.remote
@@ -365,9 +432,11 @@ def _free_ports(n):
 
 
 def run_transport(transport: str, device_dma: bool = False,
-                  pair_ceiling: bool = False) -> dict:
+                  pair_ceiling: bool = False, num_streams: int = 0,
+                  sharded: bool = False) -> dict:
     res = _run_two_party(
-        _party_main, transport, (device_dma, pair_ceiling), timeout_s=600
+        _party_main, transport,
+        (device_dma, pair_ceiling, num_streams, sharded), timeout_s=600,
     )
     import statistics
 
@@ -401,6 +470,18 @@ def _tune(sock) -> None:
         pass
 
 
+def _lane_stats(out: dict, key: str, res: dict) -> None:
+    """Record a lane's max (capability, the headline) plus median and
+    min/max spread of the same rep samples — one lucky rep on this class
+    of shared VM can double "max", and a gating script needs the robust
+    statistic next to it."""
+    out[key] = round(res["max"], 3)
+    out[f"{key}_median"] = round(res["median"], 3)
+    out[f"{key}_spread"] = [
+        round(min(res["samples"]), 3), round(max(res["samples"]), 3)
+    ]
+
+
 def _try_tpu_lanes() -> dict:
     """The ``transport='tpu'`` lanes, CPU-forced (on this driver there is
     ONE real chip and two party processes cannot share it; a wedged
@@ -415,19 +496,162 @@ def _try_tpu_lanes() -> dict:
       is the engine itself (~0.6 GB/s bare-engine measurement, STATUS);
       on a pod the engine rides ICI.
 
+    Each key comes with ``_median`` and ``_spread`` companions.
     Best-effort: records nothing when the backend is unavailable."""
     out = {}
     with _cpu_forced():
         try:
-            out["tpu_lane_gbps"] = round(run_transport("tpu")["max"], 3)
+            _lane_stats(out, "tpu_lane_gbps", run_transport("tpu"))
         except Exception as e:  # noqa: BLE001
             print(f"tpu-lane bench skipped: {e!r}", file=sys.stderr)
         try:
-            out["dma_cpu_gbps"] = round(
-                run_transport("tpu", device_dma=True)["max"], 3
+            _lane_stats(
+                out, "dma_cpu_gbps", run_transport("tpu", device_dma=True)
             )
         except Exception as e:  # noqa: BLE001
             print(f"dma bench skipped: {e!r}", file=sys.stderr)
+    return out
+
+
+_MULTISTREAM_LANES = 4
+
+
+@contextlib.contextmanager
+def _cpu_devices(n: int = 8):
+    """:func:`_cpu_forced` plus a forced multi-device host platform —
+    the sharded-pipeline and psum lanes need >1 device per process."""
+    flag = f"--xla_force_host_platform_device_count={n}"
+    saved = os.environ.get("XLA_FLAGS")
+    os.environ["XLA_FLAGS"] = f"{saved} {flag}" if saved else flag
+    try:
+        with _cpu_forced():
+            yield
+    finally:
+        if saved is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = saved
+
+
+def _psum_agg_entry(result_path, n_parties, rounds, payload_elems):
+    """Spawned child: flat-plan aggregation lowered to one collective
+    across a composed party mesh (ops.aggregate.psum_by_plan), checked
+    bitwise against the reduce_by_plan fold it replaces, then timed."""
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from rayfed_tpu import mesh as mesh_mod
+    from rayfed_tpu import topology as topo
+    from rayfed_tpu.ops.aggregate import psum_by_plan, reduce_by_plan
+
+    parties = [f"p{i}" for i in range(n_parties)]
+    mesh_mod.compose_party_mesh(parties)
+    plan = topo.plan(parties, "flat")
+    rng = np.random.default_rng(7)
+    contributions = {
+        p: {"w": rng.standard_normal(payload_elems).astype(np.float32)}
+        for p in parties
+    }
+
+    def timed(fn):
+        dts = []
+        out = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            out = fn(plan, contributions)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+            dts.append((time.perf_counter() - t0) * 1000)
+        return out, dts
+
+    ref, _ = timed(reduce_by_plan)  # warmup (compiles both folds)
+    got, _ = timed(psum_by_plan)
+    leaves = zip(jax.tree_util.tree_leaves(got),
+                 jax.tree_util.tree_leaves(ref))
+    assert all(
+        np.asarray(a).tobytes() == np.asarray(b).tobytes()
+        for a, b in leaves
+    ), "psum_by_plan diverged from reduce_by_plan bits"
+    _, psum_dts = timed(psum_by_plan)
+    _, fold_dts = timed(reduce_by_plan)
+    with open(result_path, "w") as f:
+        json.dump(
+            {
+                "psum_agg_ms": round(statistics.median(psum_dts), 3),
+                "psum_agg_ms_spread": [
+                    round(min(psum_dts), 3), round(max(psum_dts), 3)
+                ],
+                "fold_agg_ms": round(statistics.median(fold_dts), 3),
+            },
+            f,
+        )
+
+
+def _run_psum_agg() -> dict:
+    mp = multiprocessing.get_context("spawn")
+    with tempfile.TemporaryDirectory() as tmp:
+        result_path = os.path.join(tmp, "psum.json")
+        p = mp.Process(
+            target=_psum_agg_entry,
+            args=(
+                result_path,
+                int(os.environ.get("FEDTPU_BENCH_PSUM_PARTIES", 4)),
+                int(os.environ.get("FEDTPU_BENCH_PSUM_ROUNDS", 20)),
+                int(os.environ.get("FEDTPU_BENCH_PSUM_ELEMS", 1 << 20)),
+            ),
+        )
+        p.start()
+        p.join(timeout=300)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=30)
+            raise RuntimeError("psum agg child hung")
+        if p.exitcode != 0 or not os.path.exists(result_path):
+            raise RuntimeError(f"psum agg child failed rc={p.exitcode}")
+        with open(result_path) as f:
+            return json.load(f)
+
+
+def _try_data_plane() -> dict:
+    """The sharded multi-stream data plane:
+
+    - ``multistream_gbps``: the tpu transport with
+      ``num_streams=_MULTISTREAM_LANES`` reactor lanes and a chunked
+      payload — stripe frames ride K sockets in parallel and the
+      receiver reassembles them (tools/dma_check.py gates this against
+      ``dma_cpu_gbps``).
+    - ``shard_pipeline_gbps``: same lanes, payload a 4-way sharded
+      jax.Array on a forced 8-device CPU backend — the shard-extent
+      striping + per-shard async D2H pipeline end to end.
+    - ``psum_agg_ms``: flat-plan aggregation as ONE collective across a
+      composed 4-party mesh, bitwise-checked against reduce_by_plan
+      (+ ``fold_agg_ms``, the host fold it replaces, for the ratio).
+
+    Best-effort, like :func:`_try_tpu_lanes`."""
+    out = {}
+    with _cpu_forced():
+        try:
+            _lane_stats(
+                out, "multistream_gbps",
+                run_transport("tpu", num_streams=_MULTISTREAM_LANES),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"multistream bench skipped: {e!r}", file=sys.stderr)
+    with _cpu_devices(8):
+        try:
+            _lane_stats(
+                out, "shard_pipeline_gbps",
+                run_transport(
+                    "tpu", num_streams=_MULTISTREAM_LANES, sharded=True
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"shard pipeline bench skipped: {e!r}", file=sys.stderr)
+        try:
+            out.update(_run_psum_agg())
+        except Exception as e:  # noqa: BLE001
+            print(f"psum agg bench skipped: {e!r}", file=sys.stderr)
     return out
 
 
@@ -1228,6 +1452,7 @@ def main() -> None:
         print(f"paired baseline skipped: {e!r}", file=sys.stderr)
     result.setdefault("vs_baseline", result["vs_baseline_unpaired"])
     result.update(tpu_lanes)
+    result.update(_try_data_plane())
     if mfu:
         result.update(mfu)
     # BASELINE.json configs #1/#3/#4/#5 as driver keys; #1 and #3 also

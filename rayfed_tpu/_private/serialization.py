@@ -130,6 +130,11 @@ class SegmentedPayload:
     def tobytes(self) -> bytes:
         return b"".join(bytes(v) for _, v in self._segments)
 
+    def segments(self):
+        """The (absolute_offset, view) pairs — for re-basing a stripe's
+        local segments into the reassembled payload's address space."""
+        return list(self._segments)
+
 
 def payload_nbytes(payload) -> int:
     if payload is None:
@@ -194,6 +199,104 @@ def tree_segment_lengths(meta_bytes: bytes, plen: int):
             return None
         return lengths
     except Exception:  # noqa: BLE001 - malformed meta -> single-buffer read
+        return None
+
+
+def _coalesce_sizes(sizes):
+    """Apply the ``_MIN_SEGMENT`` coalescing rule to a list of extent
+    sizes (shared between :func:`tree_segment_lengths` and the per-stripe
+    segment plans — extents always stay inside one segment)."""
+    lengths = []
+    for n in sizes:
+        if not n:
+            continue
+        if lengths and lengths[-1] < _MIN_SEGMENT and n < _MIN_SEGMENT:
+            lengths[-1] += n
+        else:
+            lengths.append(n)
+    return lengths
+
+
+# ---------------------------------------------------------------------------
+# Shard striping (multi-stream data plane): a large multi-buffer ``tree``
+# payload is split at buffer boundaries into K contiguous byte-balanced
+# stripes, each shipped as its own frame (``pkind: "stripe"``) over its
+# own wire lane; the receiver reassembles them into one SegmentedPayload
+# whose segments stay leaf/shard-aligned. Stripe frames carry a stripe
+# descriptor ``sd``: {"i": stripe index, "n": stripe count, "off":
+# absolute byte offset, "tot": total payload bytes, "segs": this
+# stripe's scatter-read segment lengths}; stripe 0 additionally carries
+# the original pkind/pmeta (``pk``/``pm`` live in the outer header as
+# pkind/pmeta of the reassembled offer).
+# ---------------------------------------------------------------------------
+
+# A payload below this never stripes: lane-parallelism only pays at
+# frame-pipelining scale, and the receive path's segment machinery wants
+# shard-scale buffers.
+STRIPE_MIN_BYTES = 1 << 20
+
+
+def plan_stripes(buffers, k: int):
+    """Partition ordered payload buffers into up to ``k`` contiguous,
+    byte-balanced stripes, split only at buffer boundaries (so every
+    leaf/shard extent stays inside one stripe and, within it, one
+    scatter segment). Returns [(soff, bufs, nbytes, segs), ...] or None
+    when striping is pointless (fewer than 2 non-empty buffers, or
+    k <= 1)."""
+    entries = []
+    off = 0
+    for b in buffers:
+        n = buffer_nbytes(b)
+        if n:
+            entries.append((off, b, n))
+        off += n
+    total = off
+    k = min(k, len(entries))
+    if k <= 1 or total < STRIPE_MIN_BYTES:
+        return None
+    stripes = []
+    i = 0
+    done = 0
+    for si in range(k):
+        left = k - si
+        target = (total - done + left - 1) // left
+        soff = entries[i][0]
+        bufs = []
+        nbytes = 0
+        while i < len(entries):
+            # Leave at least one buffer for every remaining stripe.
+            if bufs and (len(entries) - i) <= (left - 1):
+                break
+            if bufs and nbytes >= target:
+                break
+            bufs.append(entries[i][1])
+            nbytes += entries[i][2]
+            i += 1
+        stripes.append((
+            soff, bufs, nbytes,
+            _coalesce_sizes([buffer_nbytes(b) for b in bufs]),
+        ))
+        done += nbytes
+    return stripes
+
+
+def stripe_segment_lengths(sd, plen: int):
+    """Validated scatter-read segment lengths from a stripe frame's
+    descriptor, or None for a single contiguous read. Shared by the
+    Python and native receive paths, like :func:`tree_segment_lengths`."""
+    try:
+        segs = sd.get("segs")
+        if not isinstance(segs, list) or len(segs) < 2:
+            return None
+        total = 0
+        for n in segs:
+            if not isinstance(n, int) or n <= 0:
+                return None
+            total += n
+        if total != plen:
+            return None
+        return segs
+    except Exception:  # noqa: BLE001 - malformed descriptor -> single read
         return None
 
 
@@ -294,6 +397,17 @@ def try_encode_sharded(leaf, offset: int):
     shard_entries.sort(key=lambda e: tuple(a for ab in e[0] for a in ab))
     mesh = sharding.mesh
     spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+    # Kick off every shard's device->host copy before materializing any of
+    # them: the transfers overlap each other (and, on real TPUs, the wire
+    # work of earlier shards) instead of serializing one np.asarray at a
+    # time. No-op on backends without async transfer.
+    for _, s in shard_entries:
+        start = getattr(s.data, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # noqa: BLE001 - overlap is best-effort
+                break
     descs = []
     buffers = []
     total = 0

@@ -186,6 +186,15 @@ class CrossSiloMessageConfig:
     # peer-reachable interface, not loopback.
     device_dma: bool = False
     dma_listen_addr: str = "127.0.0.1:0"
+    # Same-mesh push fast path (opt-in; colocated deployments only):
+    # when sender and receiver parties live in ONE process sharing a
+    # composed party mesh (mesh.compose_party_mesh — the CPU simulator,
+    # single-host test rigs, in-process benches), an all-array payload is
+    # lowered to jax.device_put onto the destination party's sub-mesh and
+    # only a tiny reference frame crosses the socket. Never enable it for
+    # parties in separate processes: the reference cannot resolve there
+    # and the send fails loudly at decode.
+    same_mesh_push: bool = False
     # Small-message fast path: payloads at or below this many bytes skip
     # the per-message fixed costs that dominate latency-bound rounds —
     # they ride the compact msgpack encoding (no tree walk for plain
@@ -272,6 +281,18 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
             connections are distributed over. One loop comfortably
             drives tens of peers; raise it only when a single reactor
             core saturates.
+        num_streams: parallel wire lanes per destination for striped
+            bulk payloads (reactor mode only). When a ``tree`` payload
+            is at least ~1MB and has several buffers (a sharded array's
+            per-shard views, a many-leaf gradient pytree), its buffers
+            are striped across this many connections concurrently and
+            reassembled shard-aligned on the receiver — the sharded
+            data plane's host-staging tax killer. 1 (default) keeps the
+            single-lane wire byte-for-byte unchanged; K>1 changes only
+            framing for payloads that meet the striping gate (both ends
+            must run a stripe-aware build). Small frames, compressed
+            payloads, error envelopes, and the TLS/device-DMA threaded
+            paths never stripe.
     """
 
     retry_policy: Optional[Dict[str, Any]] = None
@@ -282,6 +303,7 @@ class TcpCrossSiloMessageConfig(CrossSiloMessageConfig):
     send_window: int = 8
     use_reactor: bool = True
     num_reactors: int = 1
+    num_streams: int = 1
 
     def get_retry_policy(self) -> RetryPolicy:
         return RetryPolicy.from_dict(self.retry_policy)

@@ -80,6 +80,45 @@ def _premul(tree, w):
     return (jax.tree_util.tree_map(lambda x: x * w, tree), w)
 
 
+@fed.remote
+def _agg_psum_flat(parties, weights, *trees):
+    # Same-mesh lowering: the whole flat reduction as ONE task at the
+    # root — a single shard_map collective across the composed mesh's
+    # party axis. Falls back to the identical-bits local fold when the
+    # executing process has no composed mesh registered (e.g. a replayed
+    # DAG in a plain process), so the result never depends on which path
+    # ran.
+    from rayfed_tpu import mesh as mesh_mod
+    from rayfed_tpu import topology as topo_mod
+    from rayfed_tpu.ops.aggregate import psum_by_plan, reduce_by_plan
+
+    plan = topo_mod.plan(list(parties), "flat")
+    contributions = dict(zip(parties, trees))
+    if mesh_mod.composed_mesh_for(plan.parties) is None:
+        return reduce_by_plan(plan, contributions, weights=weights)
+    return psum_by_plan(plan, contributions, weights=weights)
+
+
+def _try_same_mesh_aggregate(plan, objs, op, weights):
+    """Lower a flat plan to a single-psum task at the root when every
+    party resolves onto one registered composed mesh. Returns the result
+    FedObject, or None to keep the stepwise DAG lowering."""
+    from rayfed_tpu import mesh as mesh_mod
+
+    if op not in ("mean", "wmean"):
+        return None  # psum_by_plan computes a weighted mean
+    if not topo.plan_is_flat(plan) or len(plan.parties) < 2:
+        return None
+    if mesh_mod.composed_mesh_for(plan.parties) is None:
+        return None
+    w = None
+    if op == "wmean":
+        w = {p: float(weights[p]) for p in plan.parties}
+    return _agg_psum_flat.party(plan.root).remote(
+        tuple(plan.parties), w, *[objs[p] for p in plan.parties]
+    )
+
+
 def fed_aggregate(
     objs: Dict[str, Any],
     op: str = "mean",
@@ -135,6 +174,14 @@ def fed_aggregate(
                 f"op='wmean' weights missing entries for parties "
                 f"{sorted(missing_w)}"
             )
+
+    # Same-mesh fast path: a flat plan over parties that compose into one
+    # registered mesh lowers to a single collective task at the root.
+    fast = _try_same_mesh_aggregate(plan, objs, op, weights)
+    if fast is not None:
+        return fast
+
+    if op == "wmean":
         held = {
             p: _premul.party(p).remote(objs[p], float(weights[p]))
             for p in plan.parties

@@ -130,3 +130,91 @@ def clear_party_mesh() -> None:
     global _party_mesh, _party_mesh_config
     _party_mesh = None
     _party_mesh_config = None
+    clear_composed_mesh()
+
+
+# ---------------------------------------------------------------------------
+# Composed party mesh (same-mesh fast path)
+# ---------------------------------------------------------------------------
+#
+# When the parties of a job are colocated on one device pool — the CPU
+# simulator, a single-host multi-party test rig, or a pod slice shared via
+# jax.distributed — their sub-meshes compose into ONE mesh with a leading
+# "party" axis (party x data x model ...). Registering that composition
+# unlocks the same-mesh fast paths: pushes lower to jax.device_put onto
+# the destination party's sub-mesh (no wire, no host staging) and flat
+# aggregation plans lower to a single collective across the party axis
+# (ops.aggregate.psum_by_plan). The registry is process-local and
+# strictly opt-in; nothing engages unless it is populated.
+
+_composed_mesh = None
+_composed_parties: Optional[tuple] = None
+
+
+def compose_party_mesh(parties, devices=None, inner_axes=None,
+                       inner_shape=None):
+    """Compose and register the job's party x data x model mesh.
+
+    ``parties`` fixes the party-axis order (coordinate p on the "party"
+    axis IS ``parties[p]``), so every process must pass the same order —
+    sorted names or config order, the multi-controller contract. Inner
+    axes default to this party's established mesh shape (so the composed
+    mesh is party x <party mesh>), else a 1-D ``data`` axis.
+    """
+    global _composed_mesh, _composed_parties
+    from rayfed_tpu.collective import party_axis_mesh
+
+    parties = tuple(dict.fromkeys(parties))
+    if len(parties) < 2:
+        raise ValueError("composing a party mesh needs at least 2 parties")
+    if inner_axes is None:
+        if _party_mesh is not None:
+            inner_axes = tuple(str(a) for a in _party_mesh.axis_names)
+            if inner_shape is None:
+                inner_shape = tuple(int(d) for d in _party_mesh.devices.shape)
+        else:
+            inner_axes = ("data",)
+    composed = party_axis_mesh(
+        len(parties), devices=devices,
+        inner_axes=tuple(inner_axes), inner_shape=inner_shape,
+    )
+    _composed_mesh = composed
+    _composed_parties = parties
+    logger.info(
+        "Composed party mesh registered: parties=%s shape=%s",
+        parties, dict(zip(composed.axis_names, composed.devices.shape)),
+    )
+    return composed
+
+
+def composed_mesh_for(parties):
+    """The registered composed mesh iff it covers exactly ``parties`` in
+    the registered order (plans index the party axis by position), else
+    None."""
+    if _composed_mesh is None or tuple(parties) != _composed_parties:
+        return None
+    return _composed_mesh
+
+
+def get_composed_parties() -> Optional[tuple]:
+    return _composed_parties
+
+
+def party_submesh(party: str):
+    """One party's inner sub-mesh of the composed mesh (its slice along
+    the party axis, with the inner axes only), or None when no composed
+    mesh covers it."""
+    if _composed_mesh is None or party not in (_composed_parties or ()):
+        return None
+    from jax.sharding import Mesh
+
+    i = _composed_parties.index(party)
+    return Mesh(
+        _composed_mesh.devices[i], tuple(_composed_mesh.axis_names[1:])
+    )
+
+
+def clear_composed_mesh() -> None:
+    global _composed_mesh, _composed_parties
+    _composed_mesh = None
+    _composed_parties = None

@@ -142,6 +142,121 @@ def reduce_by_plan(
     )
 
 
+def psum_by_plan(
+    plan,
+    contributions,
+    weights=None,
+    acc_dtype: Optional[str] = "float32",
+    mesh=None,
+    deterministic: bool = True,
+):
+    """Lower a FLAT plan to one collective across the composed party
+    mesh's ``party`` axis — the same weighted mean :func:`reduce_by_plan`
+    computes, BITWISE-equal, in a single shard_map program instead of a
+    premultiply/fold/scale chain.
+
+    Eligibility: ``topology.plan_is_flat(plan)`` and a composed mesh
+    registered for exactly ``plan.parties``
+    (``mesh.compose_party_mesh``), or passed via ``mesh=``. Each party's
+    contribution is premultiplied by its weight in its own dtype, stacked
+    along the party axis, and reduced on device.
+
+    ``deterministic=True`` (default) all_gathers the party slots and
+    folds them in plan order in ``acc_dtype`` — the exact association
+    :func:`reduce_by_plan` uses, so bit-equality holds on every backend.
+    ``deterministic=False`` lowers to a raw ``jax.lax.psum``, whose
+    association order is backend-defined: bitwise-equal on backends whose
+    all-reduce folds linearly (the CPU simulator does), cheaper on TPU
+    rings, but not a portable bit-contract.
+    """
+    from rayfed_tpu import mesh as mesh_mod
+    from rayfed_tpu import topology as topo
+
+    if not topo.plan_is_flat(plan):
+        raise ValueError(
+            f"psum_by_plan needs a flat plan; got topology="
+            f"{plan.topology!r} with {plan.num_rounds} rounds"
+        )
+    missing = set(plan.parties) - set(contributions)
+    if missing:
+        raise ValueError(
+            f"plan references parties with no contribution: {sorted(missing)}"
+        )
+    parties = plan.parties
+    ws = [1.0 if weights is None else weights[p] for p in parties]
+    # Premultiply in the leaf's own dtype, then total the weights the way
+    # reduce_by_plan's ``sum()`` does (0 + w0 + w1 + ...): both choices
+    # are part of the bit contract.
+    pre = [
+        jax.tree_util.tree_map(lambda x, w=w: x * w, contributions[p])
+        for p, w in zip(parties, ws)
+    ]
+    total = sum(ws)
+    if len(parties) == 1:
+        return jax.tree_util.tree_map(lambda x: x / total, pre[0])
+    if mesh is None:
+        mesh = mesh_mod.composed_mesh_for(parties)
+    if mesh is None:
+        raise ValueError(
+            f"no composed party mesh registered for parties {parties} "
+            "(call mesh.compose_party_mesh first)"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = len(parties)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jax.device_put(
+            jnp.stack([jnp.asarray(x) for x in xs]),
+            NamedSharding(mesh, P("party")),
+        ),
+        *pre,
+    )
+    reduced = _psum_flat_fn(mesh, n, acc_dtype or "", deterministic)(stacked)
+    # Every party slot holds the identical sum; slot 0 stands in. The
+    # division happens HERE, outside the cached program, so changing
+    # weights between rounds never recompiles — same op on the same
+    # values as reduce_by_plan's final scale, so the bits still match.
+    return jax.tree_util.tree_map(lambda x: x[0] / total, reduced)
+
+
+@functools.lru_cache(maxsize=32)
+def _psum_flat_fn(mesh, n: int, acc_dtype: str, deterministic: bool):
+    """The compiled party-axis reduction for :func:`psum_by_plan`. Cached
+    on (mesh, n, acc_dtype, deterministic) — repeat aggregation rounds on
+    the same composed mesh reuse one XLA program instead of re-tracing
+    the shard_map every call (jit's own cache handles leaf shapes)."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # jax 0.4.x
+        from jax.experimental.shard_map import shard_map
+
+    dtype = jnp.dtype(acc_dtype) if acc_dtype else None
+
+    def body(local_tree):
+        def leaf(x):  # x: this party's slot, shape (1, ...)
+            orig = x.dtype
+            if deterministic:
+                g = jax.lax.all_gather(x[0], "party", axis=0)
+                acc = g[0].astype(dtype) if dtype is not None else g[0]
+                for i in range(1, n):
+                    nxt = g[i].astype(dtype) if dtype is not None else g[i]
+                    acc = acc + nxt
+            else:
+                acc = jax.lax.psum(
+                    x[0].astype(dtype) if dtype is not None else x[0],
+                    "party",
+                )
+            return acc.astype(orig)[None]
+
+        return jax.tree_util.tree_map(leaf, local_tree)
+
+    return jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("party"), out_specs=P("party"))
+    )
+
+
 def elastic_weighted_mean(
     contributions,
     weights=None,

@@ -345,6 +345,21 @@ def _host_snapshot(value):
                     out.append(x)
         else:
             out.append(x)
+    # Start every multi-device copy's per-shard D2H transfer now, while
+    # the send is still queuing: by the time the wire encoder reaches
+    # np.asarray(shard.data) the bytes are already landing, so the
+    # device->host staging overlaps scheduling (and, with striping, the
+    # wire work of earlier shards) instead of serializing behind it.
+    for x in out:
+        if isinstance(x, j.Array) and getattr(
+            x, "is_fully_addressable", False
+        ) and len(x.sharding.device_set) > 1:
+            try:
+                for s in x.addressable_shards:
+                    if s.replica_id == 0:
+                        s.data.copy_to_host_async()
+            except Exception:  # noqa: BLE001 - optional overlap only
+                break
     return tree_util.tree_unflatten(out, spec)
 
 

@@ -146,10 +146,48 @@ class GrpcSenderProxy(SenderProxy):
 
     def send(self, dest_party, data, upstream_seq_id, downstream_seq_id,
              is_error: bool = False) -> Future:
-        return self._pool.submit(
-            self._send_sync, dest_party, data, upstream_seq_id,
-            downstream_seq_id, is_error,
-        )
+        # Deferred dispatch: a send whose data is still a pending Future
+        # must NOT occupy a pool worker while it waits — with the whole
+        # round's sends registered upfront (the driver lays the DAG out
+        # eagerly), max_workers blocked `data.result()` calls starve the
+        # pool and anything behind them (including the error envelope
+        # cleanup emits when a data send fails, whose delivery is what
+        # unblocks the peer's parked recv) queues forever: a cross-party
+        # deadlock. Mirror the TCP lane's done-callback dispatch instead:
+        # wire work is only ever submitted with a *resolved* value.
+        out: Future = Future()
+
+        def dispatch(resolved) -> None:
+            try:
+                fut = self._pool.submit(
+                    self._send_sync, dest_party, resolved,
+                    upstream_seq_id, downstream_seq_id, is_error,
+                )
+            except RuntimeError as e:  # pool shut down
+                out.set_exception(FedLocalError(e))
+                return
+            fut.add_done_callback(_copy_result)
+
+        def _copy_result(fut: Future) -> None:
+            err = fut.exception()
+            if err is not None:
+                out.set_exception(err)
+            else:
+                out.set_result(fut.result())
+
+        if isinstance(data, Future):
+            def on_ready(f: Future) -> None:
+                try:
+                    value = f.result()
+                except BaseException as e:  # noqa: BLE001
+                    out.set_exception(FedLocalError(e))
+                    return
+                dispatch(value)
+
+            data.add_done_callback(on_ready)
+        else:
+            dispatch(data)
+        return out
 
     def _send_sync(self, dest_party, data, upstream_seq_id, downstream_seq_id,
                    is_error: bool) -> bool:
@@ -157,7 +195,7 @@ class GrpcSenderProxy(SenderProxy):
 
         from rayfed_tpu import tracing
 
-        if isinstance(data, Future):
+        if isinstance(data, Future):  # defense in depth: send() resolves
             try:
                 data = data.result()
             except BaseException as e:  # noqa: BLE001

@@ -75,6 +75,139 @@ def default_decode(allowed_list, allow_pickle: bool = True, sharded_fn=None,
     return decode
 
 
+class StripeAssembler:
+    """Reassembles striped bulk frames in front of a rendezvous offer.
+
+    The multi-stream sender splits one large ``tree`` payload into K
+    ``stripe`` frames shipped over K parallel connections (possibly
+    serviced by different reactor threads, in any order). This wrapper
+    buffers stripes per (job, src, up, down) edge and, when the last one
+    lands, re-offers the reassembled payload — as a
+    :class:`serialization.SegmentedPayload` whose segments stay
+    leaf/shard-aligned — under the original pkind/pmeta. Non-stripe
+    frames pass straight through.
+
+    Ack semantics: every non-completing stripe is acked OK on arrival
+    (its bytes are safely buffered); the COMPLETING stripe's ack carries
+    the store's real verdict, so a store-side rejection fails exactly
+    one sender-side stripe future and with it the send. Duplicate
+    stripes (PR 6 ack-lost resends) are acked OK and dropped, matching
+    the store's consumed-dedup behavior.
+    """
+
+    # Bounds concurrent half-assembled groups (and with them the bytes a
+    # misbehaving peer can park here): the sender stripes one payload per
+    # edge at a time, so double digits is already generous.
+    _MAX_GROUPS = 256
+
+    def __init__(self, offer, max_payload_bytes: Optional[int] = None):
+        self._offer = offer
+        self._max_payload_bytes = max_payload_bytes
+        self._lock = threading.Lock()
+        self._groups: Dict[tuple, Dict] = {}
+        self._done: "OrderedDict[tuple, None]" = OrderedDict()
+        self._done_cap = 4096
+
+    @staticmethod
+    def _validate_sd(sd) -> Optional[str]:
+        if not isinstance(sd, dict):
+            return "stripe frame missing its descriptor"
+        for field in ("i", "n", "off", "tot"):
+            if not isinstance(sd.get(field), int):
+                return f"stripe descriptor field {field!r} missing/not int"
+        if not 2 <= sd["n"] <= 64:
+            return f"stripe count {sd['n']} out of range [2, 64]"
+        if not 0 <= sd["i"] < sd["n"]:
+            return f"stripe index {sd['i']} out of range"
+        if sd["off"] < 0 or sd["tot"] <= 0 or sd["off"] >= sd["tot"]:
+            return "stripe offsets inconsistent"
+        return None
+
+    def offer(self, header: Dict, payload) -> Tuple[int, str]:
+        if header.get("pkind") != "stripe":
+            return self._offer(header, payload)
+        sd = header.get("sd")
+        err = self._validate_sd(sd)
+        if err is not None:
+            return CODE_INTERNAL_ERROR, err
+        nbytes = serialization.payload_nbytes(payload)
+        if sd["off"] + nbytes > sd["tot"]:
+            return CODE_INTERNAL_ERROR, "stripe overruns its declared total"
+        if (
+            self._max_payload_bytes is not None
+            and sd["tot"] > self._max_payload_bytes
+        ):
+            return (
+                CODE_INTERNAL_ERROR,
+                f"striped payload declares {sd['tot']} bytes, exceeding "
+                f"limit {self._max_payload_bytes}",
+            )
+        key = (
+            header.get("job"), header.get("src"),
+            header.get("up"), header.get("down"),
+        )
+        with self._lock:
+            if key in self._done:
+                return CODE_OK, "duplicate stripe group"
+            st = self._groups.get(key)
+            if st is None:
+                if len(self._groups) >= self._MAX_GROUPS:
+                    return (
+                        CODE_INTERNAL_ERROR,
+                        "too many half-assembled stripe groups",
+                    )
+                st = self._groups[key] = {
+                    "n": sd["n"], "tot": sd["tot"], "have": {},
+                    "pk": None, "pm": b"",
+                }
+            if sd["n"] != st["n"] or sd["tot"] != st["tot"]:
+                return (
+                    CODE_INTERNAL_ERROR,
+                    "stripe descriptor disagrees within its group",
+                )
+            if sd["i"] in st["have"]:
+                return CODE_OK, "duplicate stripe"
+            st["have"][sd["i"]] = (sd["off"], payload)
+            if sd["i"] == 0:
+                st["pk"] = header.get("pk")
+                st["pm"] = header.get("pmeta", b"")
+            if len(st["have"]) < st["n"]:
+                return CODE_OK, "stripe buffered"
+            # Complete: retire the group under the lock, assemble outside.
+            self._groups.pop(key, None)
+            self._done[key] = None
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
+        segments = []
+        for i in sorted(st["have"]):
+            soff, p = st["have"][i]
+            if isinstance(p, serialization.SegmentedPayload):
+                # Re-base the stripe's local scatter segments into the
+                # payload's global address space.
+                for off, view in p.segments():
+                    segments.append((soff + off, view))
+            else:
+                segments.append((soff, memoryview(p)))
+        segments.sort(key=lambda e: e[0])
+        pos = 0
+        for off, view in segments:
+            if off != pos:
+                return (
+                    CODE_INTERNAL_ERROR,
+                    f"stripes do not tile the payload (gap at byte {pos})",
+                )
+            pos += memoryview(view).nbytes
+        if pos != st["tot"]:
+            return (
+                CODE_INTERNAL_ERROR,
+                f"assembled {pos} bytes != declared total {st['tot']}",
+            )
+        inner = {k: v for k, v in header.items() if k not in ("sd", "pk")}
+        inner["pkind"] = st["pk"] or "tree"
+        inner["pmeta"] = st["pm"] or b""
+        return self._offer(inner, serialization.SegmentedPayload(segments))
+
+
 class RendezvousStore:
     def __init__(
         self,

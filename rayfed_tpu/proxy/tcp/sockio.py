@@ -173,18 +173,24 @@ def _segment_sizes(header: Dict, plen: int):
     frame is received into one contiguous buffer. Shared by the Python
     and native receive paths — the segmentation rule must never diverge
     between them (TLS rides the Python path, plaintext the native)."""
-    if (
-        plen >= _SEGMENT_THRESHOLD
-        and header.get("pkind") == "tree"
-        and "comp" not in header
-    ):
-        from rayfed_tpu._private import serialization
+    if plen >= _SEGMENT_THRESHOLD and "comp" not in header:
+        pkind = header.get("pkind")
+        if pkind == "tree":
+            from rayfed_tpu._private import serialization
 
-        lengths = serialization.tree_segment_lengths(
-            header.get("pmeta", b""), plen
-        )
-        if lengths is not None and len(lengths) > 1:
-            return lengths
+            lengths = serialization.tree_segment_lengths(
+                header.get("pmeta", b""), plen
+            )
+            if lengths is not None and len(lengths) > 1:
+                return lengths
+        elif pkind == "stripe":
+            # Stripe frames carry their own pre-validated segment plan
+            # (the sender computed it from the same coalescing rule).
+            from rayfed_tpu._private import serialization
+
+            return serialization.stripe_segment_lengths(
+                header.get("sd") or {}, plen
+            )
     return None
 
 

@@ -48,7 +48,7 @@ import socket
 import ssl
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from queue import Empty, Queue
 from typing import Dict, Optional, Tuple
 
@@ -118,6 +118,7 @@ class _DestWorker(threading.Thread):
         self._sock: Optional[socket.socket] = None
         self._closed = False
         self._lane = None
+        self._lanes: list = []
         self._small_threshold = max(
             0, getattr(self._cfg, "small_message_threshold", 0) or 0
         )
@@ -140,15 +141,25 @@ class _DestWorker(threading.Thread):
                 small_threshold=self._small_threshold,
             )
             if use_reactor:
-                self._lane = reactor_mod.ReactorLane(
-                    dest_party,
-                    reactor=proxy._reactor_for(dest_party),
-                    **lane_kwargs,
-                )
+                # K parallel lanes for shard striping; lane 0 carries all
+                # ordinary traffic, the extras only ever see stripe frames.
+                # Each lane gets its own connection and (round-robin over
+                # the reactor pool) possibly its own reactor thread.
+                num_streams = max(1, getattr(self._cfg, "num_streams", 1))
+                self._lanes = [
+                    reactor_mod.ReactorLane(
+                        dest_party,
+                        reactor=proxy._reactor_for(dest_party, i),
+                        **lane_kwargs,
+                    )
+                    for i in range(num_streams)
+                ]
+                self._lane = self._lanes[0]
             else:
                 from rayfed_tpu.proxy.tcp.pipeline import PipelinedLane
 
                 self._lane = PipelinedLane(dest_party, **lane_kwargs)
+                self._lanes = [self._lane]
         # The device-DMA lane's register step is not vetted for arbitrary
         # submitter threads, so it keeps the serialized worker.
         self._threaded = self._lane is None or not use_reactor or bool(
@@ -189,13 +200,74 @@ class _DestWorker(threading.Thread):
         self._attach_done_callbacks(
             out, on_done, payload_len, upstream_seq_id, downstream_seq_id
         )
+        if self._try_submit_striped(out, header, buffers, payload_len):
+            return
         self._lane.submit(out, header, buffers, payload_len)
+
+    def _try_submit_striped(self, out, header, buffers, payload_len) -> bool:
+        """Stripe one large multi-buffer tree payload across all lanes.
+
+        Engages only when it can win: multiple lanes configured, an
+        uncompressed ``tree`` payload big enough to amortize the extra
+        frames, more than one wire buffer (stripes split strictly at
+        buffer — i.e. leaf/shard extent — boundaries so the receiver's
+        scatter segments stay intact), and not an error envelope (errors
+        ride the ordered lane 0). Returns False to fall through to the
+        single-lane path."""
+        if (
+            len(self._lanes) <= 1
+            or header.get("is_error")
+            or header.get("pkind") != "tree"
+            or "comp" in header
+            or payload_len < serialization.STRIPE_MIN_BYTES
+        ):
+            return False
+        plan = serialization.plan_stripes(buffers, len(self._lanes))
+        if plan is None or len(plan) <= 1:
+            return False
+        n = len(plan)
+        agg_lock = threading.Lock()
+        state = {"left": n}
+
+        def _on_part(f: Future) -> None:
+            err = f.exception()
+            if err is None and f.result() is not True:
+                err = ConnectionError("stripe send rejected by peer")
+            with agg_lock:
+                if err is None:
+                    state["left"] -= 1
+                finished = state["left"] == 0
+            try:
+                if err is not None:
+                    out.set_exception(err)
+                elif finished:
+                    out.set_result(True)
+            except InvalidStateError:
+                pass  # another stripe already resolved the send
+
+        for i, (soff, bufs, nbytes, segs) in enumerate(plan):
+            h = dict(header)
+            h["pkind"] = "stripe"
+            h["sd"] = {
+                "i": i, "n": n, "off": soff, "tot": payload_len,
+                "segs": segs,
+            }
+            if i == 0:
+                h["pk"] = header["pkind"]
+            else:
+                h["pmeta"] = b""
+            part: Future = Future()
+            part.add_done_callback(_on_part)
+            self._lanes[i % len(self._lanes)].submit(part, h, bufs, nbytes)
+        return True
 
     def close(self) -> None:
         self._closed = True
         if self._threaded:
             self._jobs.put(None)
-        if self._lane is not None:
+        for lane in self._lanes or ():
+            lane.close()
+        if self._lane is not None and self._lane not in self._lanes:
             self._lane.close()
 
     # -- connection management ----------------------------------------------
@@ -434,7 +506,9 @@ class _DestWorker(threading.Thread):
             "down": str(downstream_seq_id),
             "is_error": bool(is_error),
         }
-        special = self._proxy._try_encode_special(value, is_error, cfg)
+        special = self._proxy._try_encode_special(
+            value, is_error, cfg, dest_party=self._dest
+        )
         if special is not None:
             kind, payload, on_done = special
             header["pkind"] = kind
@@ -559,24 +633,27 @@ class TcpSenderProxy(SenderProxy):
         self._reactors = None  # lazily acquired pool refs (reactor mode)
         self._reactor_lock = threading.Lock()
 
-    def _reactor_for(self, dest_party: str):
+    def _reactor_for(self, dest_party: str, lane_index: int = 0):
         """A reactor from the shared pool for this destination's lane —
         peers are spread across the pool by stable hash so N parties load
-        ``num_reactors`` loops evenly."""
+        ``num_reactors`` loops evenly. Striped destinations ask once per
+        lane (``lane_index``) so their K connections land on K distinct
+        reactor threads when the pool is that deep."""
         with self._reactor_lock:
             if self._reactors is None:
                 self._reactors = reactor_mod.acquire_reactors(
                     max(1, getattr(self._config, "num_reactors", 1))
                 )
             rs = self._reactors
-        return rs[hash(dest_party) % len(rs)]
+        return rs[(hash(dest_party) + lane_index) % len(rs)]
 
-    def _try_encode_special(self, value, is_error: bool, cfg):
+    def _try_encode_special(self, value, is_error: bool, cfg,
+                            dest_party: Optional[str] = None):
         """Subclass hook: divert a payload to an alternate lane. Returns
         (pkind, payload_bytes, on_done) — ``on_done(ok: bool)`` is called
         when the send future resolves, for lane-side accounting — or None
-        for the standard encode path (the TPU transport's device-DMA
-        descriptor frames plug in here)."""
+        for the standard encode path (the TPU transport's device-DMA and
+        same-mesh reference frames plug in here)."""
         return None
 
     def _bump_stat(self, key: str) -> None:
@@ -634,6 +711,14 @@ class TcpReceiverProxy(ReceiverProxy):
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
             allow_pickle=self._config.allow_pickle_payloads,
         )
+        # Multi-stream senders split bulk payloads into stripe frames
+        # that arrive interleaved over K connections; the assembler
+        # buffers and re-offers them whole. Non-stripe traffic passes
+        # through untouched.
+        self._offer = rendezvous.StripeAssembler(
+            self._store.offer,
+            max_payload_bytes=self._config.effective_max_message_bytes(),
+        ).offer
         self._listener: Optional[socket.socket] = None
         self._ready_result = None
         self._open_conns: set = set()
@@ -799,7 +884,7 @@ class TcpReceiverProxy(ReceiverProxy):
             r = self._reactors[self._next_reactor % len(self._reactors)]
             self._next_reactor += 1
             handler = reactor_mod.ServerConnection(
-                r, conn, peer, self._store.offer, on_close=on_close,
+                r, conn, peer, self._offer, on_close=on_close,
                 max_payload=self._config.effective_max_message_bytes(),
             )
         except OSError as e:
@@ -901,7 +986,7 @@ class TcpReceiverProxy(ReceiverProxy):
                          "fseq": header.get("fseq")},
                     )
                     continue
-                code, msg = self._store.offer(header, payload)
+                code, msg = self._offer(header, payload)
                 # Echo the sender's frame sequence number: pipelined acks
                 # are matched by fseq, never by position.
                 queue_resp(

@@ -100,6 +100,173 @@ def _advertised_addr(bound: str, listen_host: str) -> str:
     return f"{listen_host}:{port}"
 
 
+# ---------------------------------------------------------------------------
+# Socket fallback engine: jax builds without ``jax.experimental.transfer``
+# (the API landed behind a version gate) still get the lane's *semantics* —
+# buffers parked on the producer, descriptor-only frames, pull-exactly-once
+# — over a plain socket bulk transport. Device-to-device becomes
+# device→host→wire→device, so it matches the CPU-simulation regime the
+# real engine's socket transport uses on this class of host anyway.
+# ---------------------------------------------------------------------------
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            raise ConnectionError("transfer peer closed mid-message")
+        got += r
+    return bytes(buf)
+
+
+def _read_msg(sock) -> dict:
+    import struct
+
+    (n,) = struct.unpack("!I", _read_exact(sock, 4))
+    if n > 1 << 20:
+        raise ValueError(f"transfer control message too large ({n} bytes)")
+    return msgpack.unpackb(_read_exact(sock, n), raw=False)
+
+
+def _write_msg(sock, msg: dict) -> None:
+    import struct
+
+    blob = msgpack.packb(msg, use_bin_type=True)
+    sock.sendall(struct.pack("!I", len(blob)) + blob)
+
+
+class _SocketTransferConnection:
+    """Client half of the fallback engine (one TCP connection, pulls
+    serialized under a lock — the rendezvous store pulls one descriptor
+    at a time per edge anyway)."""
+
+    def __init__(self, addr: str):
+        import socket as _socket
+
+        host, port = addr.rsplit(":", 1)
+        self._sock = _socket.create_connection((host, int(port)), timeout=60)
+        try:
+            self._sock.setsockopt(
+                _socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1
+            )
+        except OSError:  # pragma: no cover - platform-specific
+            pass
+        self._lock = threading.Lock()
+
+    def pull(self, uuid: int, sds: List):
+        import jax
+        import numpy as np
+
+        with self._lock:
+            _write_msg(self._sock, {"uuid": uuid})
+            reply = _read_msg(self._sock)
+            if "error" in reply:
+                raise RuntimeError(
+                    f"transfer pull failed: {reply['error']}"
+                )
+            lens = reply["lens"]
+            if len(lens) != len(sds):
+                raise RuntimeError(
+                    f"transfer pull returned {len(lens)} leaves, "
+                    f"expected {len(sds)}"
+                )
+            raws = [_read_exact(self._sock, n) for n in lens]
+        out = []
+        for raw, sd in zip(raws, sds):
+            arr = np.frombuffer(raw, dtype=sd.dtype).reshape(sd.shape)
+            out.append(jax.device_put(arr, sd.sharding))
+        return out
+
+
+class _SocketTransferServer:
+    """Server half: parks pinned leaves per uuid; each uuid is served
+    exactly once (popped on request) — matching the real engine's
+    pull-once semantics that the rendezvous deliver-once guarantee
+    relies on."""
+
+    def __init__(self, listen_host: str):
+        import socket as _socket
+
+        self._sock = _socket.socket()
+        self._sock.setsockopt(
+            _socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1
+        )
+        self._sock.bind((listen_host, 0))
+        self._sock.listen(16)
+        self._addr = f"{listen_host}:{self._sock.getsockname()[1]}"
+        self._pending: Dict[int, List] = {}
+        self._lock = threading.Lock()
+        t = threading.Thread(
+            target=self._accept_loop, name="fedtpu-dma-fallback", daemon=True
+        )
+        t.start()
+
+    def address(self) -> str:
+        return self._addr
+
+    def await_pull(self, uuid: int, leaves: List) -> None:
+        # Holding the list pins the buffers until pulled (jax arrays are
+        # kept alive by the reference), like the real engine.
+        with self._lock:
+            self._pending[uuid] = list(leaves)
+
+    def connect(self, addr: str) -> _SocketTransferConnection:
+        return _SocketTransferConnection(addr)
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:  # pragma: no cover - socket torn down
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,),
+                name="fedtpu-dma-fallback-conn", daemon=True,
+            ).start()
+
+    def _serve(self, conn) -> None:
+        import numpy as np
+
+        from rayfed_tpu._private import serialization
+
+        try:
+            while True:
+                req = _read_msg(conn)
+                with self._lock:
+                    leaves = self._pending.pop(req.get("uuid"), None)
+                if leaves is None:
+                    _write_msg(conn, {
+                        "error": f"unknown or already-pulled uuid "
+                                 f"{req.get('uuid')}"
+                    })
+                    continue
+                # _array_buffer handles ml_dtypes leaves (bfloat16/fp8)
+                # the buffer protocol rejects directly.
+                bufs = [
+                    serialization._array_buffer(
+                        np.ascontiguousarray(np.asarray(x))
+                    )
+                    for x in leaves
+                ]
+                del leaves  # buffers unpinned as soon as staged to host
+                _write_msg(
+                    conn,
+                    {"lens": [memoryview(b).nbytes for b in bufs]},
+                )
+                for b in bufs:
+                    conn.sendall(b)
+        except (ConnectionError, OSError, ValueError):
+            pass  # peer gone / malformed: drop this connection only
+        finally:
+            try:
+                conn.close()
+            except OSError:  # pragma: no cover
+                pass
+
+
 def get_transfer_server(listen_addr: str = "127.0.0.1:0"):
     """The process-wide transfer server (lazy; one per process), or None
     when unavailable on this backend — callers then use the socket lane."""
@@ -109,13 +276,13 @@ def get_transfer_server(listen_addr: str = "127.0.0.1:0"):
             return _server, _server_addr
         if _server_failed is not None:
             return None, None
-        try:
-            import random
+        import random
 
+        host = listen_addr.rsplit(":", 1)[0]
+        try:
             import jax
             from jax.experimental import transfer
 
-            host = listen_addr.rsplit(":", 1)[0]
             client = jax.local_devices()[0].client
             # Explicit transport_addresses pin the socket bulk transport
             # (the implicit same-host "local" transport CHECK-fails
@@ -124,16 +291,25 @@ def get_transfer_server(listen_addr: str = "127.0.0.1:0"):
                 client, listen_addr, [f"{host}:0"]
             )
             _server_addr = _advertised_addr(_server.address(), host)
-            # uuids are scoped to this server; the random base keeps
-            # repeat fed.init() in one process from reusing ids.
-            _uuid_counter = itertools.count(random.getrandbits(30) << 20)
-        except Exception as e:  # noqa: BLE001 - degrade to socket lane
-            _server_failed = str(e)
-            logger.warning(
-                "device-DMA transfer server unavailable (%s); pushes use "
-                "the socket lane.", e,
-            )
-            return None, None
+        except Exception as e:  # noqa: BLE001 - try the socket fallback
+            try:
+                _server = _SocketTransferServer(host)
+                _server_addr = _server.address()
+                logger.info(
+                    "jax transfer engine unavailable (%s); using the "
+                    "socket-fallback transfer engine at %s.",
+                    e, _server_addr,
+                )
+            except Exception as e2:  # noqa: BLE001 - degrade to socket lane
+                _server_failed = f"{e}; fallback: {e2}"
+                logger.warning(
+                    "device-DMA transfer server unavailable (%s); pushes "
+                    "use the socket lane.", _server_failed,
+                )
+                return None, None
+        # uuids are scoped to this server; the random base keeps
+        # repeat fed.init() in one process from reusing ids.
+        _uuid_counter = itertools.count(random.getrandbits(30) << 20)
         return _server, _server_addr
 
 
@@ -212,7 +388,12 @@ def pull(meta_payload, listen_addr: str = "127.0.0.1:0",
     addr = desc["addr"]
     total = 0
     for e in desc["leaves"]:
-        total += int(math.prod(e["shape"])) * np.dtype(e["dtype"]).itemsize
+        # _np_dtype: ml_dtypes names (bfloat16/fp8) that bare np.dtype
+        # rejects.
+        total += (
+            int(math.prod(e["shape"]))
+            * serialization._np_dtype(e["dtype"]).itemsize
+        )
     if max_bytes is not None and total > max_bytes:
         raise ValueError(
             f"dma descriptor declares {total} bytes, exceeding the "
@@ -233,7 +414,8 @@ def pull(meta_payload, listen_addr: str = "127.0.0.1:0",
     sharding = jax.sharding.SingleDeviceSharding(dev)
     sds: List = [
         jax.ShapeDtypeStruct(
-            tuple(e["shape"]), np.dtype(e["dtype"]), sharding=sharding
+            tuple(e["shape"]), serialization._np_dtype(e["dtype"]),
+            sharding=sharding,
         )
         for e in desc["leaves"]
     ]

@@ -30,12 +30,102 @@ to ``psum`` over the joint mesh instead of point-to-point pushes.
 
 from __future__ import annotations
 
+import itertools
 import logging
+import threading
+from collections import OrderedDict
 
 from rayfed_tpu.proxy import rendezvous
 from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Same-mesh push fast path (``same_mesh_push: true``; colocated parties)
+# ---------------------------------------------------------------------------
+#
+# When both parties of a push share this process's composed party mesh
+# (``mesh.compose_party_mesh``), the payload never needs the wire at all:
+# the sender ``jax.device_put``s every leaf onto the DESTINATION party's
+# sub-mesh (a device-to-device scatter over the party axis), parks the
+# placed tree in this table, and ships only a tiny ``meshref`` token
+# frame. The receiver's decode resolves the token back to the already-
+# placed tree. Process-local by construction — the config knob documents
+# that it must only be enabled for colocated deployments.
+
+_SAME_MESH_CAP = 1024  # leak bound: failed sends evict via on_done
+
+_same_mesh_lock = threading.Lock()
+_same_mesh_table: "OrderedDict[int, object]" = OrderedDict()
+_same_mesh_tokens = itertools.count(1)
+
+
+def _try_post_same_mesh(value, dest_party):
+    """Place ``value`` onto ``dest_party``'s sub-mesh and park it for the
+    in-process receiver. Returns ("meshref", payload, on_done) or None
+    when the fast path does not apply (no composed mesh for the
+    destination, or a non-array leaf)."""
+    import sys
+
+    j = sys.modules.get("jax")
+    if j is None or dest_party is None:
+        return None
+    from rayfed_tpu import tree_util
+    from rayfed_tpu.mesh import party_submesh
+
+    submesh = party_submesh(dest_party)
+    if submesh is None:
+        return None
+    try:
+        leaves, _ = tree_util.tree_flatten(value)
+    except Exception:  # noqa: BLE001 - unflattenable -> wire lane
+        return None
+    import numpy as np
+
+    if not leaves or not all(
+        isinstance(x, (j.Array, np.ndarray)) for x in leaves
+    ):
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(submesh, PartitionSpec())
+    try:
+        placed = j.tree_util.tree_map(
+            lambda x: j.device_put(x, sharding), value
+        )
+    except Exception as e:  # noqa: BLE001 - placement refused -> wire lane
+        logger.debug("same-mesh placement declined: %s", e)
+        return None
+    token = next(_same_mesh_tokens)
+    with _same_mesh_lock:
+        _same_mesh_table[token] = placed
+        while len(_same_mesh_table) > _SAME_MESH_CAP:
+            _same_mesh_table.popitem(last=False)
+
+    def on_done(ok: bool) -> None:
+        if not ok:
+            with _same_mesh_lock:
+                _same_mesh_table.pop(token, None)
+
+    import msgpack
+
+    return "meshref", msgpack.packb({"tok": token}), on_done
+
+
+def _take_same_mesh(payload):
+    import msgpack
+
+    tok = msgpack.unpackb(bytes(memoryview(payload)), raw=False)["tok"]
+    with _same_mesh_lock:
+        placed = _same_mesh_table.pop(tok, None)
+    if placed is None:
+        raise ValueError(
+            f"same-mesh reference {tok} not found in this process: "
+            "same_mesh_push requires sender and receiver parties to be "
+            "colocated (see cross_silo_comm.same_mesh_push)"
+        )
+    return placed
 
 
 class TpuSenderProxy(TcpSenderProxy):
@@ -46,10 +136,20 @@ class TpuSenderProxy(TcpSenderProxy):
     With ``device_dma: true`` in the comm config, all-jax-Array payloads
     skip host staging entirely: the buffers are parked on this process's
     transfer server and only a descriptor frame crosses the socket (see
-    :mod:`rayfed_tpu.proxy.tpu.dma`)."""
+    :mod:`rayfed_tpu.proxy.tpu.dma`). With ``same_mesh_push: true`` and a
+    composed party mesh registered, the payload is device_put straight
+    onto the destination party's sub-mesh and only a reference frame is
+    sent (colocated deployments)."""
 
-    def _try_encode_special(self, value, is_error: bool, cfg):
-        if is_error or not getattr(cfg, "device_dma", False):
+    def _try_encode_special(self, value, is_error: bool, cfg,
+                            dest_party=None):
+        if is_error:
+            return None
+        if getattr(cfg, "same_mesh_push", False):
+            posted = _try_post_same_mesh(value, dest_party)
+            if posted is not None:
+                return posted
+        if not getattr(cfg, "device_dma", False):
             return None
         from rayfed_tpu.proxy.tpu import dma
 
@@ -69,6 +169,10 @@ def _device_placer(allowed_list, allow_pickle: bool = True,
     )
 
     def decode(header, payload):
+        if header.get("pkind") == "meshref":
+            # Same-mesh push: the tree is already device-resident on this
+            # party's sub-mesh — resolve the in-process reference as-is.
+            return _take_same_mesh(payload)
         if header.get("pkind") == "dma":
             if not device_dma:
                 raise ValueError(

@@ -145,6 +145,20 @@ class TopologyPlan:
             )
 
 
+def plan_is_flat(plan: TopologyPlan) -> bool:
+    """True when the schedule is a single k-ary star folding every party
+    into the root in party order — the only shape the same-mesh fast
+    path can lower to one collective across the composed mesh's party
+    axis (``ops.aggregate.psum_by_plan``). Single-party plans (no steps)
+    count: their reduction is the identity fold."""
+    if not plan.levels:
+        return len(plan.parties) == 1
+    if len(plan.levels) != 1 or len(plan.levels[0]) != 1:
+        return False
+    (step,) = plan.levels[0]
+    return step.dst == plan.root and step.srcs == plan.parties
+
+
 def resolve_auto(n: int) -> str:
     """The shape ``auto`` picks for ``n`` surviving parties."""
     if n <= 2:

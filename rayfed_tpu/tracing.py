@@ -146,6 +146,43 @@ def export_chrome_trace(path: str, party: str = "") -> int:
     return len(events)
 
 
+def export_timeline(path: str, party: str = "") -> int:
+    """Write a plain-text per-seq-id send/recv/ack timeline — the hang
+    forensics artifact (ISSUE 7 satellite): when a bench party wedges,
+    the watchdog's signal triggers this next to the faulthandler stack
+    dump, so the last wire event per rendezvous edge is visible without
+    a debugger. Grouped by (upstream_seq_id, downstream_seq_id), events
+    time-ordered within each edge. Returns the number of events written.
+
+    Signal-handler safe: the span ring is snapshotted with a
+    non-blocking lock attempt (a handler interrupting the recording
+    thread mid-append must not deadlock on the tracing lock; deques are
+    safe to iterate without it at worst losing the in-flight span)."""
+    acquired = _lock.acquire(blocking=False)
+    try:
+        spans = list(_spans)
+    finally:
+        if acquired:
+            _lock.release()
+    edges: Dict[tuple, List[Span]] = {}
+    for s in spans:
+        edges.setdefault((s.upstream_seq_id, s.downstream_seq_id), []).append(s)
+    n = 0
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"# rayfed_tpu wire timeline party={party or '?'} "
+                f"spans={len(spans)}\n")
+        for (up, down), group in sorted(edges.items()):
+            f.write(f"\n[{up} -> {down}]\n")
+            for s in sorted(group, key=lambda s: s.start_s):
+                f.write(
+                    f"  {s.start_s:16.6f} +{s.duration_s * 1e3:9.3f}ms "
+                    f"{s.kind:<6} peer={s.peer or '?':<10} "
+                    f"nbytes={s.nbytes:<12} ok={s.ok}\n"
+                )
+                n += 1
+    return n
+
+
 def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
            nbytes: int, start_s: float, ok: bool = True) -> None:
     """Directly append a span (for async paths where a context manager

@@ -1,0 +1,141 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Regression tests for the gRPC sender's dispatch discipline.
+
+The BENCH_r05 fedavg hang root cause: ``GrpcSenderProxy.send`` used to
+submit EVERY send to its 8-worker pool immediately, and the worker then
+blocked on ``data.result()`` when the payload was a still-pending Future.
+A driver that lays out a whole multi-round DAG upfront registers dozens
+of sends whose producers haven't run — 8 of them park 8 workers, and
+everything behind them (including the ``FedRemoteError`` envelope cleanup
+emits when a data send fails, whose delivery is what unblocks the peer's
+parked recv) queues forever: a cross-party deadlock. Captured all-thread
+stacks showed exactly 8 workers in ``data.result()`` and the cleanup
+thread waiting 120s on the envelope's send future.
+
+The fix defers dispatch via ``add_done_callback``: pool workers only ever
+run sends whose data is already resolved.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from rayfed_tpu._private.constants import CODE_OK  # noqa: E402
+from rayfed_tpu.exceptions import FedLocalError  # noqa: E402
+from rayfed_tpu.proxy.grpc import fedproto  # noqa: E402
+from rayfed_tpu.proxy.grpc.grpc_proxy import GrpcSenderProxy  # noqa: E402
+
+
+class _FakeChannel:
+    """Answers every unary call with an OK SendDataResponse — no network,
+    so the test exercises the real dispatch + _send_sync path only."""
+
+    def unary_unary(self, path, request_serializer=None,
+                    response_deserializer=None):
+        def call(request, timeout=None):
+            return fedproto.encode_send_data_response(CODE_OK, "ok")
+
+        return call
+
+
+@pytest.fixture
+def proxy():
+    p = GrpcSenderProxy(
+        {"alice": "127.0.0.1:1", "bob": "127.0.0.1:1"},
+        "alice", "job-dispatch", None, {},
+    )
+    p._get_channel = lambda dest: _FakeChannel()
+    yield p
+    p.stop()
+
+
+def test_pending_futures_do_not_starve_the_pool(proxy):
+    """More unresolved-data sends than pool workers, then a ready error
+    envelope: the envelope must complete promptly instead of queueing
+    behind workers blocked on data resolution (the deadlock shape)."""
+    n_workers = proxy._pool._max_workers
+    pending = [Future() for _ in range(2 * n_workers)]
+    futs = [
+        proxy.send("bob", f, f"alice_seq_{i}", f"bob_seq_{i}")
+        for i, f in enumerate(pending)
+    ]
+    # The error envelope is what breaks the peer's parked recv in the
+    # production failure — it must go out with every data send pending.
+    env = proxy.send("bob", "boom-envelope", "alice_err", "bob_err",
+                     is_error=True)
+    assert env.result(timeout=30) is True
+    # No pending-data send may have completed (their producers never ran).
+    assert not any(f.done() for f in futs)
+    # Resolution dispatches the wire work; order of resolution is free.
+    for i in (3, 0, len(pending) - 1):
+        pending[i].set_result(f"value-{i}")
+        assert futs[i].result(timeout=30) is True
+    for i, f in enumerate(pending):
+        if not f.done():
+            f.set_result(i)
+    for f in futs:
+        assert f.result(timeout=30) is True
+
+
+def test_failed_producer_resolves_send_without_a_worker(proxy):
+    """A producer failure surfaces as FedLocalError on the send future
+    directly from the done callback — no pool worker consumed."""
+    data = Future()
+    fut = proxy.send("bob", data, "alice_x", "bob_x")
+    data.set_exception(RuntimeError("producer exploded"))
+    with pytest.raises(FedLocalError):
+        fut.result(timeout=30)
+
+
+def test_send_after_stop_fails_cleanly(proxy):
+    data = Future()
+    fut = proxy.send("bob", data, "alice_y", "bob_y")
+    proxy.stop()
+    data.set_result("late")
+    with pytest.raises(FedLocalError):
+        fut.result(timeout=30)
+
+
+def test_concurrent_resolution_storm(proxy):
+    """Many producers resolving from many threads at once: every send
+    lands exactly once and the op counter matches."""
+    n = 40
+    pending = [Future() for _ in range(n)]
+    futs = [
+        proxy.send("bob", f, f"a{i}", f"b{i}") for i, f in enumerate(pending)
+    ]
+    start = threading.Barrier(8)
+
+    def resolver(chunk):
+        start.wait()
+        for i in chunk:
+            pending[i].set_result(i)
+
+    threads = [
+        threading.Thread(target=resolver, args=(range(k, n, 8),))
+        for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(f.result(timeout=30) is True for f in futs)
+    assert proxy.get_stats()["send_op_count"] == n

@@ -1,0 +1,257 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Same-mesh fast path: composed party mesh registry, flat-plan psum
+lowering (bitwise-equal to reduce_by_plan), and the device_put push lane.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rayfed_tpu import mesh as mesh_mod
+from rayfed_tpu import topology as topo
+from rayfed_tpu.ops.aggregate import psum_by_plan, reduce_by_plan
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    mesh_mod.clear_composed_mesh()
+    yield
+    mesh_mod.clear_composed_mesh()
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_compose_and_lookup_exact_party_order():
+    m = mesh_mod.compose_party_mesh(["alice", "bob"])
+    assert m.axis_names[0] == "party"
+    assert m.shape["party"] == 2
+    assert mesh_mod.composed_mesh_for(("alice", "bob")) is m
+    assert mesh_mod.composed_mesh_for(["alice", "bob"]) is m
+    # Wrong order or wrong set: the party-axis coordinates would lie.
+    assert mesh_mod.composed_mesh_for(("bob", "alice")) is None
+    assert mesh_mod.composed_mesh_for(("alice", "bob", "carol")) is None
+
+
+def test_party_submesh_slices_the_party_axis():
+    m = mesh_mod.compose_party_mesh(["alice", "bob"])
+    sub_a = mesh_mod.party_submesh("alice")
+    sub_b = mesh_mod.party_submesh("bob")
+    assert sub_a.axis_names == tuple(m.axis_names[1:])
+    assert set(np.ravel(sub_a.devices)) == set(np.ravel(m.devices[0]))
+    assert set(np.ravel(sub_b.devices)) == set(np.ravel(m.devices[1]))
+    assert not set(np.ravel(sub_a.devices)) & set(np.ravel(sub_b.devices))
+    assert mesh_mod.party_submesh("carol") is None
+
+
+def test_clear_party_mesh_clears_composition():
+    mesh_mod.compose_party_mesh(["alice", "bob"])
+    mesh_mod.clear_party_mesh()
+    assert mesh_mod.composed_mesh_for(("alice", "bob")) is None
+
+
+def test_compose_rejects_single_party():
+    with pytest.raises(ValueError, match="at least 2"):
+        mesh_mod.compose_party_mesh(["alice"])
+
+
+# ---------------------------------------------------------------------------
+# plan_is_flat
+# ---------------------------------------------------------------------------
+
+
+def test_plan_is_flat():
+    parties = [f"p{i}" for i in range(4)]
+    assert topo.plan_is_flat(topo.plan(parties, "flat"))
+    assert not topo.plan_is_flat(topo.plan(parties, "tree"))
+    assert not topo.plan_is_flat(topo.plan(parties, "ring"))
+    assert not topo.plan_is_flat(topo.plan(parties, "hier", group_size=2))
+    # Two parties: every shape degenerates to one star step.
+    assert topo.plan_is_flat(topo.plan(["a", "b"], "tree"))
+    # Single party: the empty schedule is the identity fold.
+    assert topo.plan_is_flat(topo.plan(["a"], "flat"))
+
+
+# ---------------------------------------------------------------------------
+# psum_by_plan: bitwise equality with reduce_by_plan
+# ---------------------------------------------------------------------------
+
+
+def _tree_for(n_parties, dtype, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": (rng.standard_normal((33, 17))
+                  * 10.0 ** rng.integers(-3, 4)).astype(dtype),
+            "b": rng.standard_normal(7).astype(dtype),
+        }
+        for _ in range(n_parties)
+    ]
+
+
+def _bitwise_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        and np.asarray(x).dtype == np.asarray(y).dtype
+        for x, y in zip(la, lb)
+    )
+
+
+@pytest.mark.parametrize("n_parties", [2, 4, 8])
+@pytest.mark.parametrize("deterministic", [True, False])
+def test_psum_by_plan_bitwise_equals_reduce_by_plan(n_parties, deterministic):
+    parties = [f"p{i}" for i in range(n_parties)]
+    mesh_mod.compose_party_mesh(parties)
+    plan = topo.plan(parties, "flat")
+    trees = _tree_for(n_parties, np.float32, seed=n_parties)
+    contributions = dict(zip(parties, trees))
+    weights = {p: float(3 * i + 1) for i, p in enumerate(parties)}
+    for w in (None, weights):
+        ref = reduce_by_plan(plan, contributions, weights=w)
+        out = psum_by_plan(
+            plan, contributions, weights=w, deterministic=deterministic
+        )
+        assert _bitwise_equal(out, ref)
+
+
+def test_psum_by_plan_bfloat16_leaves():
+    parties = ["alice", "bob"]
+    mesh_mod.compose_party_mesh(parties)
+    plan = topo.plan(parties, "flat")
+    contributions = {
+        p: {"w": jnp.asarray(np.arange(64, dtype=np.float32) + i,
+                             jnp.bfloat16)}
+        for i, p in enumerate(parties)
+    }
+    ref = reduce_by_plan(plan, contributions)
+    out = psum_by_plan(plan, contributions)
+    assert _bitwise_equal(out, ref)
+
+
+def test_psum_by_plan_rejects_non_flat_and_unregistered():
+    parties = [f"p{i}" for i in range(4)]
+    trees = _tree_for(4, np.float32, seed=0)
+    contributions = dict(zip(parties, trees))
+    with pytest.raises(ValueError, match="flat plan"):
+        psum_by_plan(topo.plan(parties, "tree"), contributions)
+    with pytest.raises(ValueError, match="no composed party mesh"):
+        psum_by_plan(topo.plan(parties, "flat"), contributions)
+
+
+def test_psum_by_plan_single_party_identity():
+    plan = topo.plan(["solo"], "flat")
+    tree = {"w": np.arange(8, dtype=np.float32)}
+    out = psum_by_plan(plan, {"solo": tree}, weights={"solo": 2.0})
+    ref = reduce_by_plan(plan, {"solo": tree}, weights={"solo": 2.0})
+    assert _bitwise_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# fed_aggregate lowering gate
+# ---------------------------------------------------------------------------
+
+
+def test_fed_aggregate_gate_declines_without_registry():
+    from rayfed_tpu.federated import _try_same_mesh_aggregate
+
+    plan = topo.plan(["alice", "bob"], "flat")
+    assert _try_same_mesh_aggregate(plan, {}, "mean", None) is None  # no mesh
+    mesh_mod.compose_party_mesh(["alice", "bob"])
+    tree_plan = topo.plan([f"p{i}" for i in range(4)], "tree")
+    assert _try_same_mesh_aggregate(tree_plan, {}, "mean", None) is None
+    plan_sum = topo.plan(["alice", "bob"], "flat")
+    assert _try_same_mesh_aggregate(plan_sum, {}, "sum", None) is None
+
+
+# ---------------------------------------------------------------------------
+# Same-mesh device_put push lane (in-process proxy pair)
+# ---------------------------------------------------------------------------
+
+
+def test_same_mesh_push_end_to_end():
+    from jax.sharding import NamedSharding
+    from rayfed_tpu.proxy.tpu import tpu_proxy
+    from rayfed_tpu.proxy.tpu.tpu_proxy import TpuReceiverProxy, TpuSenderProxy
+    from tests.utils import get_addresses
+
+    mesh_mod.compose_party_mesh(["alice", "bob"])
+    bob_devices = set(np.ravel(mesh_mod.party_submesh("bob").devices))
+
+    cfg = {
+        "retry_policy": {"max_attempts": 5, "initial_backoff_ms": 100},
+        "same_mesh_push": True,
+        "small_message_threshold": 0,  # keep array frames off the fast path
+    }
+    addr = get_addresses(["bob"])
+    rp = TpuReceiverProxy(addr["bob"], "bob", "job", None, dict(cfg))
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    sp = TpuSenderProxy(addr, "alice", "job", None, dict(cfg))
+    sp.start()
+    try:
+        host = np.arange(256 * 64, dtype=np.float32).reshape(256, 64)
+        tree = {"w": jnp.asarray(host), "b": jnp.ones(4, jnp.float32)}
+        fut = rp.get_data("alice", "1#0", 2)
+        assert sp.send("bob", tree, "1#0", 2).result(timeout=60)
+        got = fut.result(timeout=60)
+        np.testing.assert_array_equal(np.asarray(got["w"]), host)
+        # The tree landed ON bob's sub-mesh — placed by the sender's
+        # device_put, not reassembled from wire bytes.
+        assert isinstance(got["w"].sharding, NamedSharding)
+        assert set(got["w"].sharding.device_set) <= bob_devices
+        # The reference was consumed (no leak).
+        assert not tpu_proxy._same_mesh_table
+    finally:
+        sp.stop()
+        rp.stop()
+
+
+def test_same_mesh_push_declines_to_wire_without_registry():
+    from rayfed_tpu.proxy.tpu import tpu_proxy
+    from rayfed_tpu.proxy.tpu.tpu_proxy import TpuReceiverProxy, TpuSenderProxy
+    from tests.utils import get_addresses
+
+    cfg = {
+        "retry_policy": {"max_attempts": 5, "initial_backoff_ms": 100},
+        "same_mesh_push": True,  # enabled but no composed mesh registered
+    }
+    addr = get_addresses(["bob"])
+    rp = TpuReceiverProxy(addr["bob"], "bob", "job", None, dict(cfg))
+    rp.start()
+    ok, err = rp.is_ready()
+    assert ok, err
+    sp = TpuSenderProxy(addr, "alice", "job", None, dict(cfg))
+    sp.start()
+    try:
+        host = np.arange(1024, dtype=np.float32)
+        fut = rp.get_data("alice", "1#0", 2)
+        assert sp.send("bob", {"w": jnp.asarray(host)}, "1#0", 2).result(
+            timeout=60
+        )
+        got = fut.result(timeout=60)
+        np.testing.assert_array_equal(np.asarray(got["w"]), host)
+        assert not tpu_proxy._same_mesh_table
+    finally:
+        sp.stop()
+        rp.stop()
