@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma clean
+.PHONY: native test lint chaos latency scale dma serve clean
 
 native:
 	python setup.py build_ext --inplace
@@ -48,6 +48,16 @@ scale:
 # loudly here.
 dma:
 	JAX_PLATFORMS=cpu python tools/dma_check.py
+
+# Serving gate (docs/serving.md): the inference engine under 8
+# concurrent clients with hot swaps mid-window must hold its
+# serve_tokens_s floor and serve_p99_ms ceiling, and continuous
+# batching must stay >= FEDTPU_SERVE_BUDGET_SPEEDUP x the naive
+# one-request-at-a-time baseline — a serialized batcher or a request
+# stalled across a swap fails loudly here. Mirrors the `serve` job in
+# .github/workflows/tests.yml.
+serve:
+	JAX_PLATFORMS=cpu python tools/serve_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -40,6 +40,11 @@ from rayfed_tpu.resilience import (  # noqa: F401
     liveness_view,
     party_state,
 )
+from rayfed_tpu.serving import (  # noqa: F401
+    ServeHandle,
+    serve,
+    submit_request,
+)
 
 __version__ = "0.1.0"
 
@@ -58,5 +63,8 @@ __all__ = [
     "fault_trace",
     "liveness_view",
     "party_state",
+    "serve",
+    "submit_request",
+    "ServeHandle",
     "__version__",
 ]

@@ -249,6 +249,7 @@ class LocalExecutor:
         *,
         num_returns: int = 1,
         lane: Optional[SerialLane] = None,
+        eager: bool = True,
     ) -> Union[Future, List[Future]]:
         if num_returns == 1:
             out: Union[Future, List[Future]] = Future()
@@ -270,7 +271,7 @@ class LocalExecutor:
 
             if not lane.submit_thunk(thunk):
                 fail_all(FedActorKilledError("actor was killed"))
-        elif _deps_ready(list(args)) and _deps_ready(kwargs or {}):
+        elif eager and _deps_ready(list(args)) and _deps_ready(kwargs or {}):
             # Eager inline execution: every dependency is already
             # resolved, so the task has nothing to block on — running it
             # on the caller's thread skips the pool-dispatch wake-up AND
@@ -281,7 +282,11 @@ class LocalExecutor:
             # wait on internally is already in flight and resolves
             # without the caller's help. The latency-critical chains
             # (small federated rounds) are exactly the ones whose tiny
-            # tasks land here.
+            # tasks land here. Tasks submitted with ``eager=False`` opt
+            # out: a task that BLOCKS until other submissions make
+            # progress (e.g. a serving submit waiting on the batched
+            # decode engine) must not occupy the caller's thread, or the
+            # driver could never issue the concurrent work it waits on.
             _run_task(fn, args, kwargs, out, num_returns)
         else:
             task = _StealableTask(fn, args, kwargs, out, num_returns)

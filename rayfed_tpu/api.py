@@ -323,6 +323,17 @@ def init(
             group_size=aggregation_dict.get("group_size"),
         )
 
+    # Serving-plane job defaults (docs/serving.md): stored like the
+    # aggregation topology default — every driver reads the same dict, so
+    # a later fed.serve() builds the identical engine on every party.
+    serving_dict = config.get("serving")
+    if serving_dict is not None:
+        # Validate eagerly so a bad key rejects init, not the first serve.
+        fed_config.ServingConfig.from_dict(serving_dict)
+        from rayfed_tpu.serving import client as _serving_client
+
+        _serving_client.set_default_serving_config(serving_dict)
+
     resilience_dict = config.get("resilience") or {}
     if resilience_dict and party_process_id == 0:
         from rayfed_tpu.resilience import inject as _inject
@@ -398,6 +409,16 @@ def _shutdown(intended: bool = True):
     from rayfed_tpu import topology as _topology
 
     _topology.reset_default()
+    # Serving engines hold jitted programs and a live thread; stop them
+    # before the proxies so a submit task in flight fails loudly instead
+    # of wedging teardown. Only touch the module if something imported it
+    # (keeps jax out of control-plane-only processes).
+    _serving_server = sys.modules.get("rayfed_tpu.serving.server")
+    if _serving_server is not None:
+        _serving_server.stop_all_servers()
+    _serving_client = sys.modules.get("rayfed_tpu.serving.client")
+    if _serving_client is not None:
+        _serving_client.set_default_serving_config(None)
     barriers.stop_proxies(job_name=ctx.get_job_name())
     # Only touch the collective lane if it was ever imported — keeps jax
     # out of control-plane-only processes.
@@ -463,6 +484,7 @@ class FedRemoteFunction:
             args,
             kwargs,
             num_returns=self._options.get("num_returns", 1),
+            eager=self._options.get("eager", True),
         )
 
 

@@ -349,3 +349,63 @@ class PartyMeshConfig:
         data = data or {}
         field_names = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in data.items() if k in field_names})
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Inference serving plane knobs (``config['serving']``, docs/serving.md).
+
+    Attributes:
+        max_slots: decode rows in the pooled KV cache — the iteration-level
+            batch width. Admitted requests beyond this wait in the pending
+            queue at token granularity (continuous batching).
+        max_len: total positions (prompt + generated) a request may span;
+            sizes the pooled cache (one extra sacrificial position is
+            allocated internally).
+        max_new_tokens: default generation length when a request does not
+            specify one.
+        max_pending: admission-control bound on the waiting queue; submits
+            beyond it fail fast with ``ServerOverloadedError`` instead of
+            building unbounded latency.
+        temperature: default sampling temperature (0 = greedy).
+        eos_id: stop token (None = always decode the full length).
+        prefix_reuse: clone a live identical-(version, prompt) donor row
+            instead of re-running prefill.
+        mode: "continuous" (iteration-level batching, the serving plane) or
+            "sequential" (one request at a time — the naive baseline the
+            bench compares against).
+        prompt_buckets: prefill compiles once per bucket length; prompts
+            are right-padded up to the next bucket (padding is causally
+            invisible). None = powers of two up to ``max_len``.
+    """
+
+    max_slots: int = 8
+    max_len: int = 128
+    max_new_tokens: int = 16
+    max_pending: int = 64
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    prefix_reuse: bool = True
+    mode: str = "continuous"
+    prompt_buckets: Optional[List[int]] = None
+
+    def __post_init__(self):
+        if self.mode not in ("continuous", "sequential"):
+            raise ValueError(
+                f"serving.mode must be 'continuous' or 'sequential', "
+                f"got {self.mode!r}"
+            )
+        if self.max_new_tokens < 1:
+            raise ValueError("serving.max_new_tokens must be >= 1")
+        if self.max_new_tokens >= self.max_len:
+            raise ValueError(
+                "serving.max_new_tokens must leave room for a prompt "
+                f"(max_new_tokens={self.max_new_tokens} >= "
+                f"max_len={self.max_len})"
+            )
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "ServingConfig":
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in field_names})

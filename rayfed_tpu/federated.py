@@ -126,6 +126,7 @@ def fed_aggregate(
     topology: Optional[str] = None,
     liveness: Optional[Dict[str, str]] = None,
     plan: Optional[topo.TopologyPlan] = None,
+    publish_to: Any = None,
 ) -> Any:
     """Reduce ``{party: FedObject-of-pytree}`` along a planned topology.
 
@@ -145,6 +146,13 @@ def fed_aggregate(
     plan: a pre-computed :class:`~rayfed_tpu.topology.TopologyPlan` —
         overrides ``topology``/``liveness`` (callers that already
         re-planned mid-round pass the new plan directly).
+    publish_to: a :class:`~rayfed_tpu.serving.ServeHandle` — the
+        continuous train-and-serve hookup (docs/serving.md): the fresh
+        aggregate is hot-published to the serving engine as its next
+        version (an owner-push over the bulk lane when the plan root is
+        not the serving party). In-flight generations finish on the
+        version they pinned; the aggregate FedObject is still returned
+        for the next round.
     """
     assert objs, "need at least one party's object"
     if plan is None:
@@ -179,6 +187,8 @@ def fed_aggregate(
     # registered mesh lowers to a single collective task at the root.
     fast = _try_same_mesh_aggregate(plan, objs, op, weights)
     if fast is not None:
+        if publish_to is not None:
+            publish_to.publish(fast)
         return fast
 
     if op == "wmean":
@@ -204,9 +214,11 @@ def fed_aggregate(
 
     root, root_owner = held[plan.root], plan.root
     if op == "mean":
-        return _scale.party(root_owner).remote(root, float(len(plan.parties)))
-    if op == "wmean":
-        return _scale_weighted.party(root_owner).remote(root)
+        root = _scale.party(root_owner).remote(root, float(len(plan.parties)))
+    elif op == "wmean":
+        root = _scale_weighted.party(root_owner).remote(root)
+    if publish_to is not None:
+        publish_to.publish(root)
     return root
 
 

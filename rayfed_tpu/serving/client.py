@@ -1,0 +1,197 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``fed.serve()`` / ``fed.submit_request()`` — the federated client
+surface of the serving plane.
+
+Two traffic classes on two lanes: a request is a handful of token ids and
+its response a handful more — msgpack-clean and far under the small-
+message threshold, so submits ride the PR 5 inline fast lane; a publish
+flows a whole param tree from the aggregate's owner to the serving party,
+riding the bulk (and, when enabled, striped multi-stream) lane. Training
+rounds and serving traffic therefore exercise both lanes concurrently.
+
+Multi-controller contract: like every fed API, each call here must run
+identically on EVERY party's driver (the remote tasks burn seq ids).
+``fed.serve`` itself burns none — it only builds the engine on the
+hosting party — but ``submit``/``publish``/``stats``/``shutdown`` are fed
+tasks. Submit tasks are issued with ``eager=False``: they block inside
+the engine until the response is ready, so they must not run inline on
+the submitting driver's thread (the executor's eager-inline path would
+serialize the very concurrency the batch exists to exploit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from rayfed_tpu import api as fed
+from rayfed_tpu._private.global_context import get_global_context
+from rayfed_tpu.config import ServingConfig
+from rayfed_tpu.fed_object import FedObject
+
+
+@fed.remote
+def _serve_submit(name: str, prompt, opts: Dict[str, Any]):
+    from rayfed_tpu.serving.server import get_server
+
+    return get_server(name).submit_and_wait(prompt, **opts)
+
+
+@fed.remote
+def _serve_publish(name: str, params, draft_params=None):
+    from rayfed_tpu.serving.server import get_server
+
+    return get_server(name).publish(params, draft_params=draft_params)
+
+
+@fed.remote
+def _serve_stats(name: str):
+    from rayfed_tpu.serving.server import get_server
+
+    return get_server(name).stats()
+
+
+@fed.remote
+def _serve_stop(name: str):
+    from rayfed_tpu.serving.server import get_server, unregister_server
+
+    get_server(name).stop()
+    unregister_server(name)
+    return True
+
+
+class ServeHandle:
+    """Every party's view of one named serving engine.
+
+    The handle is symmetric: all parties hold one, all parties make the
+    same calls; only the hosting party runs the engine. Results come back
+    as FedObjects — ``fed.get`` them (the response broadcast is itself a
+    DAG node, so every driver must reach it).
+    """
+
+    def __init__(self, party: str, name: str = "default"):
+        self.party = party
+        self.name = name
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+        mode: str = "generate",
+        n_beams: int = 4,
+    ) -> FedObject:
+        """Enqueue one request at the serving party; returns a FedObject
+        of the response dict. Issue many submits before getting any — the
+        engine batches whatever is in flight at each token boundary."""
+        opts: Dict[str, Any] = {"seed": int(seed), "mode": mode}
+        if max_new_tokens is not None:
+            opts["max_new_tokens"] = int(max_new_tokens)
+        if temperature is not None:
+            opts["temperature"] = float(temperature)
+        if mode == "beam":
+            opts["n_beams"] = int(n_beams)
+        prompt = [int(t) for t in prompt]
+        return (
+            _serve_submit.party(self.party)
+            .options(eager=False)
+            .remote(self.name, prompt, opts)
+        )
+
+    def publish(self, params, draft_params=None) -> FedObject:
+        """Install ``params`` (a value or a FedObject — e.g. the result
+        of ``fed_aggregate``) as the next served version; returns a
+        FedObject of the version number. When the aggregate lives at
+        another party this is exactly an owner-push of the param tree
+        over the bulk lane."""
+        return _serve_publish.party(self.party).remote(
+            self.name, params, draft_params
+        )
+
+    def stats(self) -> FedObject:
+        return _serve_stats.party(self.party).remote(self.name)
+
+    def shutdown(self) -> FedObject:
+        """Stop the engine (active requests finish, queued ones fail)."""
+        return _serve_stop.party(self.party).remote(self.name)
+
+
+def serve(
+    party: str,
+    model_cfg=None,
+    *,
+    config: Optional[Dict[str, Any]] = None,
+    params: Any = None,
+    draft_cfg=None,
+    cache_dtype=None,
+    name: str = "default",
+) -> ServeHandle:
+    """Start (on ``party``) and address (everywhere) a serving engine.
+
+    Every party calls this with identical arguments; the engine spins up
+    only on the hosting party. ``config`` overrides the job-level
+    ``config['serving']`` dict from ``fed.init``. ``params`` seeds
+    version 1; otherwise the first :meth:`ServeHandle.publish` does.
+
+    Burns no seq ids — the handle is pure addressing; the engine build is
+    party-local (``get_server`` resolves it inside remote tasks).
+    """
+    ctx = get_global_context()
+    if ctx is None:
+        raise RuntimeError(
+            "rayfed_tpu is not initialized; call fed.init() first."
+        )
+    if ctx.get_current_party() == party:
+        if model_cfg is None:
+            raise ValueError(
+                "fed.serve on the hosting party needs model_cfg"
+            )
+        from rayfed_tpu.serving.server import InferenceServer, register_server
+
+        merged = dict(get_default_serving_config() or {})
+        merged.update(config or {})
+        server = InferenceServer(
+            model_cfg,
+            ServingConfig.from_dict(merged),
+            params=params,
+            draft_cfg=draft_cfg,
+            cache_dtype=cache_dtype,
+            name=name,
+        )
+        register_server(server)
+    return ServeHandle(party, name)
+
+
+def submit_request(handle: ServeHandle, prompt, **opts) -> FedObject:
+    """``fed.submit_request(handle, prompt, ...)`` — sugar for
+    :meth:`ServeHandle.submit`."""
+    return handle.submit(prompt, **opts)
+
+
+# Job-level default config (config['serving'] from fed.init), following
+# the topology.set_default pattern: every driver reads the same dict, so
+# every party builds the same engine.
+_default_serving_config: Optional[Dict[str, Any]] = None
+
+
+def set_default_serving_config(d: Optional[Dict[str, Any]]) -> None:
+    global _default_serving_config
+    _default_serving_config = dict(d) if d else None
+
+
+def get_default_serving_config() -> Optional[Dict[str, Any]]:
+    return _default_serving_config

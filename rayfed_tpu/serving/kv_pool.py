@@ -1,0 +1,158 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Slot-pooled K/V cache for the serving plane.
+
+vLLM-style pooling adapted to the stacked-cache layout of
+:mod:`rayfed_tpu.models.decode`: ONE (L, max_slots, max_len+1, H, Dh)
+cache pair is allocated at server start and every request borrows one
+batch row (a *slot*) for its lifetime — no per-request allocation, no
+per-request compile (the batched decode step is shaped by the pool, not
+by the set of live requests).
+
+Sacrificial position: the cache is one position longer than ``max_len``.
+A batched decode step always runs every pool row; rows that are free, or
+pinned to a different model version than the step's params, write their
+(garbage) K/V at position ``max_len`` — a position no real query ever
+attends to (the causal mask admits k_pos <= q_pos and real positions stop
+at ``max_len - 1``). That keeps the step a fixed-shape program with no
+O(cache) masking and makes cross-version cache corruption structurally
+impossible.
+
+Slot recycling needs no zeroing: a recycled slot's stale K/V lives at
+positions the new request has not reached yet, and every position the new
+request *does* attend to was overwritten by its own prefill/decode first.
+
+Prefix reuse ("where cheap"): a slot whose live request was prefilled
+from the same (version, prompt) is a donor — its prompt region is never
+rewritten while it decodes (decode writes at positions >= prompt length),
+so an identical concurrent prompt skips the full prefill by copying the
+donor row and re-running only the last prompt token.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rayfed_tpu.models import decode
+from rayfed_tpu.models import transformer as tfm
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _copy_row(k, v, src, dst):
+    """Copy cache batch-row ``src`` over row ``dst`` (donated: in-place
+    where the backend supports aliasing)."""
+    k_row = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
+    v_row = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
+    k = jax.lax.dynamic_update_slice_in_dim(k, k_row, dst, axis=1)
+    v = jax.lax.dynamic_update_slice_in_dim(v, v_row, dst, axis=1)
+    return k, v
+
+
+class KVPool:
+    """Fixed pool of ``max_slots`` decode rows over one stacked cache.
+
+    The pool owns the cache arrays; jitted steps consume them donated and
+    the engine hands the fresh arrays back via :meth:`replace`. All slot
+    bookkeeping is lock-guarded so ``release`` may be called from request
+    completion paths while the engine thread allocates.
+    """
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        max_slots: int,
+        max_len: int,
+        dtype=None,
+    ):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if max_len < 2:
+            raise ValueError("max_len must be >= 2")
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        # One extra position: the sacrificial write target for junk rows.
+        self.junk_pos = max_len
+        cache = decode.init_cache(cfg, max_slots, max_len + 1, dtype)
+        self._k = cache["k"]
+        self._v = cache["v"]
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(max_slots))
+        # slot -> (version, prompt bytes) for live donor rows.
+        self._prefix: Dict[int, Tuple[int, bytes]] = {}
+
+    # -- cache array handoff (engine thread only) ------------------------
+
+    @property
+    def kv(self):
+        return self._k, self._v
+
+    def replace(self, k, v) -> None:
+        """Install the arrays a donated jitted step returned."""
+        self._k, self._v = k, v
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._k.nbytes) + int(self._v.nbytes)
+
+    # -- slot lifecycle --------------------------------------------------
+
+    def acquire(self) -> Optional[int]:
+        with self._lock:
+            if not self._free:
+                return None
+            return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if slot in self._free:
+                raise ValueError(f"slot {slot} double-released")
+            # The freed row's bytes stay intact until re-acquired, but only
+            # LIVE rows are donors (a re-prefill would invalidate silently).
+            self._prefix.pop(slot, None)
+            self._free.append(slot)
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    # -- prefix reuse ----------------------------------------------------
+
+    def note_prefix(self, slot: int, version: int, prompt_key: bytes) -> None:
+        with self._lock:
+            self._prefix[slot] = (version, prompt_key)
+
+    def lookup_prefix(self, version: int, prompt_key: bytes) -> Optional[int]:
+        """A live slot prefilled from exactly (version, prompt), if any."""
+        with self._lock:
+            for slot, key in self._prefix.items():
+                if key == (version, prompt_key):
+                    return slot
+        return None
+
+    def copy_row(self, src: int, dst: int) -> None:
+        """Clone donor row ``src`` into ``dst`` (engine thread only)."""
+        self._k, self._v = _copy_row(
+            self._k,
+            self._v,
+            jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32),
+        )

@@ -1,0 +1,145 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Versioned model snapshots with atomic hot swap.
+
+Publishing follows the send path's capture-at-resolution rule
+(``barriers._capture_for_send``): the param tree is snapshotted INTO the
+bank at publish time, so a trainer that immediately feeds the same
+buffers into a donating jitted step cannot tear a generation that is
+still decoding against them. A publish is one reference assignment under
+the bank lock — a reader either sees the complete old tree or the
+complete new tree, never a mix.
+
+In-flight requests pin the version they were admitted under
+(refcounted); a retired version's snapshot is dropped only after its last
+request finishes, so a swap NEVER aborts or re-bases running decodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from rayfed_tpu import tree_util
+
+
+def snapshot_tree(params: Any) -> Any:
+    """Donation-proof copy of a param tree: every jax.Array leaf becomes a
+    fresh on-device buffer, everything array-like becomes a jax array.
+    The tree structure is preserved leaf-for-leaf (same treedef the
+    checkpoint lane serializes), so shardings and dtypes survive."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            # jnp.array(copy=True) always materializes new buffers; a
+            # later donation of the caller's tree cannot invalidate ours.
+            return jnp.array(x, copy=True)
+        return x
+
+    leaves, spec = tree_util.tree_flatten(params)
+    return tree_util.tree_unflatten([leaf(x) for x in leaves], spec)
+
+
+class ModelBank:
+    """The serving party's versioned snapshot store.
+
+    ``publish`` assigns monotonically increasing versions starting at 1.
+    ``acquire``/``release`` bracket a request's use of a version; a
+    version with zero in-flight requests that is no longer current is
+    retired (its snapshot dropped) so memory stays bounded at
+    (current + versions still decoding).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current: int = 0
+        self._snapshots: Dict[int, Any] = {}
+        self._extras: Dict[int, Dict[str, Any]] = {}
+        self._refs: Dict[int, int] = {}
+        self._swap_log: List[Tuple[int, float]] = []
+
+    def publish(self, params: Any, *, copy: bool = True, **extras) -> int:
+        """Install ``params`` as the next version; returns its number.
+
+        The snapshot is taken OUTSIDE the lock (it may device-copy a big
+        tree) and the swap itself is a single assignment under it.
+        ``extras`` (e.g. ``draft_params`` for speculative serving) are
+        snapshotted and retired together with the version.
+        """
+        snap = snapshot_tree(params) if copy else params
+        extra_snap = {
+            k: (snapshot_tree(v) if copy else v)
+            for k, v in extras.items()
+            if v is not None
+        }
+        with self._lock:
+            version = self._current + 1
+            self._snapshots[version] = snap
+            self._extras[version] = extra_snap
+            self._refs.setdefault(version, 0)
+            self._current = version
+            self._swap_log.append((version, time.perf_counter()))
+            self._retire_locked()
+        return version
+
+    def current_version(self) -> int:
+        """0 until the first publish."""
+        with self._lock:
+            return self._current
+
+    def acquire(self) -> Tuple[int, Any]:
+        """Pin the current version for one request; returns (version,
+        params). Raises if nothing was ever published."""
+        with self._lock:
+            if self._current == 0:
+                raise RuntimeError(
+                    "no model published yet — call publish() (or pass "
+                    "params= to fed.serve) before submitting requests"
+                )
+            self._refs[self._current] += 1
+            return self._current, self._snapshots[self._current]
+
+    def get(self, version: int) -> Any:
+        with self._lock:
+            return self._snapshots[version]
+
+    def get_extra(self, version: int, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._extras.get(version, {}).get(key)
+
+    def release(self, version: int) -> None:
+        with self._lock:
+            self._refs[version] -= 1
+            if self._refs[version] < 0:
+                raise ValueError(f"version {version} over-released")
+            self._retire_locked()
+
+    def _retire_locked(self) -> None:
+        for v in list(self._snapshots):
+            if v != self._current and self._refs.get(v, 0) == 0:
+                del self._snapshots[v]
+                self._extras.pop(v, None)
+                self._refs.pop(v, None)
+
+    def live_versions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._snapshots)
+
+    def swap_count(self) -> int:
+        with self._lock:
+            return len(self._swap_log)

@@ -1,0 +1,675 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The serving-party request scheduler: admission control + continuous
+(iteration-level) batching with hot model swap.
+
+Orca-style continuous batching over the slot pool
+(:mod:`rayfed_tpu.serving.kv_pool`): the engine thread alternates
+*admission* (pop pending requests into free slots — prefill-then-merge at
+a token boundary) with *decode iterations* (ONE fixed-shape batched step
+over the whole pool per live model version). A finishing sequence
+releases its slot without draining the batch; a newly admitted one joins
+at the next iteration. Both jitted programs are shaped by the pool, so
+the engine compiles a handful of programs at startup cost and never
+again, regardless of request mix.
+
+Hot swap: :meth:`InferenceServer.publish` installs a new version in the
+:class:`~rayfed_tpu.serving.publish.ModelBank`; requests pin the version
+current at their admission and decode against it to completion — a swap
+changes which params *future* admissions see, never what an in-flight
+request computes (zero aborts, zero torn trees). During the handover
+window the engine simply runs one batched step per live version.
+
+Thread model: callers (fed task workers, client threads) enqueue under
+the server lock; ONE engine thread owns the cache arrays and all jitted
+dispatch. No device state is ever touched from two threads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from rayfed_tpu import tracing
+from rayfed_tpu.config import ServingConfig
+from rayfed_tpu.models import transformer as tfm
+from rayfed_tpu.serving.kv_pool import KVPool
+from rayfed_tpu.serving.publish import ModelBank
+
+logger = logging.getLogger(__name__)
+
+
+class ServerOverloadedError(RuntimeError):
+    """Admission control rejected the request: the pending queue is at
+    ``serving.max_pending``. Back off and resubmit."""
+
+
+class ServerStoppedError(RuntimeError):
+    """The server was stopped before this request was admitted."""
+
+
+def _default_buckets(max_len: int) -> List[int]:
+    """Powers of two up to max_len (always including max_len)."""
+    buckets = []
+    b = 8
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+@dataclass
+class _Request:
+    rid: str
+    prompt: np.ndarray            # (S,) int32
+    max_new_tokens: int
+    temperature: float
+    seed: int
+    mode: str                     # "generate" | "beam" | "speculative"
+    n_beams: int
+    future: Future
+    enqueue_s: float
+    version: int = 0
+    slot: int = -1
+    pos: int = 0                  # next cache write position (= seq length)
+    out: List[int] = field(default_factory=list)
+    prefix_reuse: bool = False
+    rng: Optional[np.random.Generator] = None
+    timing: Dict[str, float] = field(default_factory=dict)
+    extra_resp: Dict[str, Any] = field(default_factory=dict)
+
+
+class InferenceServer:
+    """One party's serving engine. See module docstring for the model.
+
+    Args:
+        model_cfg: the served transformer's config (all versions published
+            into this server must share it — shapes key the compiled
+            programs).
+        config: :class:`~rayfed_tpu.config.ServingConfig` (or dict).
+        params: optional initial params (published as version 1).
+        draft_cfg: optional draft-model config enabling
+            ``mode="speculative"`` requests (the draft params ride each
+            ``publish(..., draft_params=...)``).
+        cache_dtype: pooled-cache dtype override.
+    """
+
+    def __init__(
+        self,
+        model_cfg: tfm.TransformerConfig,
+        config: Optional[ServingConfig] = None,
+        *,
+        params: Any = None,
+        draft_cfg: Optional[tfm.TransformerConfig] = None,
+        cache_dtype=None,
+        name: str = "default",
+    ):
+        if isinstance(config, dict):
+            config = ServingConfig.from_dict(config)
+        self.cfg = model_cfg
+        self.scfg = config or ServingConfig()
+        self.draft_cfg = draft_cfg
+        self.name = name
+        self.bank = ModelBank()
+        self.pool = KVPool(
+            model_cfg, self.scfg.max_slots, self.scfg.max_len, cache_dtype
+        )
+        self._buckets = sorted(
+            self.scfg.prompt_buckets or _default_buckets(self.scfg.max_len)
+        )
+        self._step_fn = self._make_step_fn()
+        self._prefill_fns: Dict[int, Any] = {}
+        self._special_fns: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: "deque[_Request]" = deque()
+        self._active: Dict[int, _Request] = {}     # slot -> request
+        self._rid_counter = itertools.count()
+        self._stopping = False
+        self._fatal: Optional[BaseException] = None
+        self._stats = {
+            "submitted": 0,
+            "completed": 0,
+            "rejected": 0,
+            "prefix_hits": 0,
+            "tokens_out": 0,
+            "steps": 0,
+        }
+        self._latencies_ms: "deque[float]" = deque(maxlen=4096)
+        if params is not None:
+            self.bank.publish(params)
+        self._engine = threading.Thread(
+            target=self._engine_loop,
+            name=f"fedtpu-serve-{name}",
+            daemon=True,
+        )
+        self._engine.start()
+
+    # -- jitted programs -------------------------------------------------
+
+    def _make_step_fn(self):
+        """ONE batched decode iteration over the whole pool.
+
+        vmap over pool rows of a single-token cached forward: each row is
+        a pure function of (params, its token, its cache row, its
+        position) — rows never mix, so a request's output is independent
+        of which other requests share the batch (this is what makes
+        fixed-seed output reproducible under concurrency). Junk rows
+        (free slots / other-version requests) write at the pool's
+        sacrificial position. Cache donated: in-place on TPU.
+        """
+        import jax
+
+        from rayfed_tpu.models import decode
+
+        cfg = self.cfg
+
+        def one_row(tok, pos, k_row, v_row, params):
+            logits, cache = decode.forward_with_cache(
+                params,
+                tok[None, None],
+                {"k": k_row[:, None], "v": v_row[:, None]},
+                pos,
+                cfg,
+            )
+            return logits[0, 0], cache["k"][:, 0], cache["v"][:, 0]
+
+        rows = jax.vmap(one_row, in_axes=(0, 0, 1, 1, None),
+                        out_axes=(0, 1, 1))
+
+        def step(params, k, v, tokens, positions):
+            return rows(tokens, positions, k, v, params)
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _get_prefill_fn(self, bucket: int):
+        """Prefill one slot row from a right-padded (bucket,) prompt;
+        compiled once per bucket length. Padding K/V beyond the real
+        length is causally invisible and overwritten by decode before any
+        query could reach it."""
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        import jax
+
+        from rayfed_tpu.models import decode
+
+        cfg = self.cfg
+
+        def prefill_slot(params, k, v, prompt, slot, last_idx):
+            k_row = jax.lax.dynamic_slice_in_dim(k, slot, 1, axis=1)
+            v_row = jax.lax.dynamic_slice_in_dim(v, slot, 1, axis=1)
+            logits, cache = decode.forward_with_cache(
+                params, prompt[None], {"k": k_row, "v": v_row}, 0, cfg
+            )
+            k = jax.lax.dynamic_update_slice_in_dim(
+                k, cache["k"], slot, axis=1
+            )
+            v = jax.lax.dynamic_update_slice_in_dim(
+                v, cache["v"], slot, axis=1
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], last_idx, axis=0, keepdims=False
+            )
+            return last, k, v
+
+        fn = jax.jit(prefill_slot, donate_argnums=(1, 2))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # -- client surface --------------------------------------------------
+
+    def publish(self, params: Any, *, draft_params: Any = None) -> int:
+        """Atomically install a new model version; in-flight requests
+        finish on the version they pinned at admission."""
+        version = self.bank.publish(params, draft_params=draft_params)
+        tracing.record_request(
+            f"publish-v{version}", "publish", version=version
+        )
+        logger.info("serving[%s]: published model version %d",
+                    self.name, version)
+        return version
+
+    def submit(
+        self,
+        prompt,
+        *,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+        mode: str = "generate",
+        n_beams: int = 4,
+    ) -> Future:
+        """Enqueue one request; returns a Future of the response dict.
+
+        Admission control is synchronous: a full pending queue raises
+        :class:`ServerOverloadedError` here, on the submitter, rather
+        than growing unbounded latency inside the engine.
+        """
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if mode not in ("generate", "beam", "speculative"):
+            raise ValueError(f"unknown request mode {mode!r}")
+        if mode == "speculative" and self.draft_cfg is None:
+            raise ValueError(
+                "mode='speculative' needs a server started with draft_cfg"
+            )
+        max_new = int(max_new_tokens or self.scfg.max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if prompt.size + max_new > self.scfg.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds serving.max_len ({self.scfg.max_len})"
+            )
+        temp = self.scfg.temperature if temperature is None else temperature
+        fut: Future = Future()
+        now = time.perf_counter()
+        with self._cond:
+            if self._fatal is not None:
+                raise ServerStoppedError(
+                    f"serving engine died: {self._fatal!r}"
+                )
+            if self._stopping:
+                raise ServerStoppedError("server is stopped")
+            if len(self._pending) >= self.scfg.max_pending:
+                self._stats["rejected"] += 1
+                raise ServerOverloadedError(
+                    f"pending queue full ({self.scfg.max_pending}); "
+                    "back off and resubmit"
+                )
+            rid = f"{self.name}-{next(self._rid_counter)}"
+            req = _Request(
+                rid=rid,
+                prompt=prompt,
+                max_new_tokens=max_new,
+                temperature=float(temp),
+                seed=int(seed),
+                mode=mode,
+                n_beams=int(n_beams),
+                future=fut,
+                enqueue_s=now,
+            )
+            req.timing["enqueue"] = now
+            self._stats["submitted"] += 1
+            self._pending.append(req)
+            self._cond.notify_all()
+        tracing.record_request(rid, "enqueue", t_s=now,
+                               prompt_len=int(prompt.size), mode=mode)
+        return fut
+
+    def submit_and_wait(self, prompt, **opts) -> Dict[str, Any]:
+        return self.submit(prompt, **opts).result()
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._stats)
+            out["pending"] = len(self._pending)
+            out["active"] = len(self._active)
+            lats = list(self._latencies_ms)
+        out["current_version"] = self.bank.current_version()
+        out["swaps"] = self.bank.swap_count()
+        out["live_versions"] = self.bank.live_versions()
+        if lats:
+            out["p50_ms"] = float(np.percentile(lats, 50))
+            out["p99_ms"] = float(np.percentile(lats, 99))
+        return out
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop admission, finish ACTIVE requests, fail still-pending
+        ones with :class:`ServerStoppedError`, and join the engine."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._engine.join(timeout)
+
+    # -- engine ----------------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while (
+                        not self._stopping
+                        and not self._pending
+                        and not self._active
+                    ):
+                        self._cond.wait(0.05)
+                    if self._stopping:
+                        # Drain policy: active requests complete, queued
+                        # ones fail fast (they were never admitted, the
+                        # no-abort guarantee starts at admission).
+                        pending, self._pending = self._pending, deque()
+                        if not self._active and not pending:
+                            return
+                    else:
+                        pending = None
+                if pending:
+                    for req in pending:
+                        req.future.set_exception(
+                            ServerStoppedError("server stopped before "
+                                               "admission")
+                        )
+                self._admit()
+                self._step_groups()
+        except BaseException as e:  # noqa: BLE001 - fail loud, never hang
+            logger.exception("serving[%s]: engine died", self.name)
+            self._fail_all(e)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._cond:
+            self._fatal = exc
+            doomed = list(self._pending) + list(self._active.values())
+            self._pending.clear()
+            self._active.clear()
+        for req in doomed:
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    def _admit(self) -> None:
+        """Prefill-then-merge: move pending requests into free slots.
+        Runs between decode iterations — a token boundary for every
+        in-flight sequence."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                if self.scfg.mode == "sequential" and self._active:
+                    # Naive baseline: strictly one request end-to-end at
+                    # a time (specials already serialize on the engine).
+                    return
+                req = self._pending[0]
+                if req.mode == "generate":
+                    slot = self.pool.acquire()
+                    if slot is None:
+                        return
+                else:
+                    slot = -1
+                self._pending.popleft()
+            try:
+                self._admit_one(req, slot)
+            except BaseException as e:  # noqa: BLE001 - per-request fault
+                # A bad request (or a bug in its path) fails ITS future;
+                # the batch and the engine keep serving everyone else.
+                if slot >= 0:
+                    self.pool.release(slot)
+                if req.version:
+                    self.bank.release(req.version)
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _admit_one(self, req: _Request, slot: int) -> None:
+        req.version, params = self.bank.acquire()
+        now = time.perf_counter()
+        req.timing["admit"] = now
+        tracing.record_request(req.rid, "admit", t_s=now,
+                               version=req.version, slot=slot)
+        if req.mode != "generate":
+            self._run_special(req, params)
+            return
+        req.slot = slot
+        req.rng = np.random.default_rng(req.seed)
+        plen = int(req.prompt.size)
+        prompt_key = req.prompt.tobytes()
+
+        import jax.numpy as jnp
+
+        donor = None
+        if self.scfg.prefix_reuse:
+            donor = self.pool.lookup_prefix(req.version, prompt_key)
+        if donor is not None and donor != slot:
+            # Clone the donor's row (its prompt region is exactly what
+            # prefill wrote — decode never touches positions < plen),
+            # then one single-row step re-derives the last-position
+            # logits; the full prompt forward is skipped.
+            self.pool.copy_row(donor, slot)
+            last = self._single_row_step(
+                params, slot, int(req.prompt[-1]), plen - 1
+            )
+            req.prefix_reuse = True
+            self._stats["prefix_hits"] += 1
+        else:
+            bucket = next(
+                (b for b in self._buckets if b >= plen), self._buckets[-1]
+            )
+            bucket = max(bucket, plen)
+            padded = np.zeros(bucket, np.int32)
+            padded[:plen] = req.prompt
+            fn = self._get_prefill_fn(bucket)
+            k, v = self.pool.kv
+            last, k, v = fn(
+                params, k, v, jnp.asarray(padded),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(plen - 1, jnp.int32),
+            )
+            self.pool.replace(k, v)
+        self.pool.note_prefix(slot, req.version, prompt_key)
+        now = time.perf_counter()
+        req.timing["prefill"] = now
+        tracing.record_request(req.rid, "prefill", t_s=now,
+                               reused=req.prefix_reuse)
+        tok = self._sample(np.asarray(last, np.float32), req)
+        req.out.append(tok)
+        req.pos = plen
+        now = time.perf_counter()
+        req.timing["first_token"] = now
+        tracing.record_request(req.rid, "first_token", t_s=now)
+        if len(req.out) >= req.max_new_tokens or tok == self.scfg.eos_id:
+            self._finish(req)
+        else:
+            with self._lock:
+                self._active[slot] = req
+
+    def _single_row_step(self, params, slot: int, token: int, pos: int):
+        """One pool iteration with only ``slot`` live (all other rows are
+        junk regardless of their state — their write goes to the
+        sacrificial position, their real cache is untouched)."""
+        import jax.numpy as jnp
+
+        b = self.pool.max_slots
+        tokens = np.zeros(b, np.int32)
+        positions = np.full(b, self.pool.junk_pos, np.int32)
+        tokens[slot] = token
+        positions[slot] = pos
+        k, v = self.pool.kv
+        logits, k, v = self._step_fn(
+            params, k, v, jnp.asarray(tokens), jnp.asarray(positions)
+        )
+        self.pool.replace(k, v)
+        return np.asarray(logits, np.float32)[slot]
+
+    def _step_groups(self) -> None:
+        """One decode iteration: a batched pool step per live version
+        group. Params differ across groups but shapes do not, so every
+        group reuses the same compiled program."""
+        with self._lock:
+            groups: Dict[int, List[_Request]] = {}
+            for req in self._active.values():
+                groups.setdefault(req.version, []).append(req)
+        if not groups:
+            return
+        import jax.numpy as jnp
+
+        b = self.pool.max_slots
+        for version in sorted(groups):
+            reqs = groups[version]
+            params = self.bank.get(version)
+            tokens = np.zeros(b, np.int32)
+            positions = np.full(b, self.pool.junk_pos, np.int32)
+            for req in reqs:
+                tokens[req.slot] = req.out[-1]
+                positions[req.slot] = req.pos
+            k, v = self.pool.kv
+            logits, k, v = self._step_fn(
+                params, k, v, jnp.asarray(tokens), jnp.asarray(positions)
+            )
+            self.pool.replace(k, v)
+            self._stats["steps"] += 1
+            logits_np = np.asarray(logits, np.float32)
+            for req in reqs:
+                tok = self._sample(logits_np[req.slot], req)
+                req.out.append(tok)
+                req.pos += 1
+                if (
+                    len(req.out) >= req.max_new_tokens
+                    or tok == self.scfg.eos_id
+                ):
+                    with self._lock:
+                        self._active.pop(req.slot, None)
+                    self._finish(req)
+
+    def _sample(self, logits: np.ndarray, req: _Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / req.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(req.rng.choice(logits.shape[0], p=p))
+
+    def _finish(self, req: _Request) -> None:
+        if req.slot >= 0:
+            self.pool.release(req.slot)
+            req.slot = -1
+        self.bank.release(req.version)
+        now = time.perf_counter()
+        req.timing["finish"] = now
+        latency_ms = (now - req.enqueue_s) * 1e3
+        with self._lock:
+            self._stats["completed"] += 1
+            self._stats["tokens_out"] += len(req.out)
+            self._latencies_ms.append(latency_ms)
+        tracing.record_request(req.rid, "finish", t_s=now,
+                               n_new=len(req.out), version=req.version)
+        resp: Dict[str, Any] = {
+            "request_id": req.rid,
+            "tokens": [int(t) for t in req.out],
+            "prompt_len": int(req.prompt.size),
+            "version": int(req.version),
+            "mode": req.mode,
+            "prefix_reuse": bool(req.prefix_reuse),
+            "timing": {k: float(v) for k, v in req.timing.items()},
+            "latency_ms": float(latency_ms),
+        }
+        resp.update(req.extra_resp)
+        req.future.set_result(resp)
+
+    # -- beam / speculative (whole-request paths) ------------------------
+
+    def _run_special(self, req: _Request, params) -> None:
+        """Beam/speculative requests run as one whole-generation call on
+        the engine thread (they have their own internal batching and do
+        not join the iteration-level batch; admission still pins a
+        version, so swap semantics are identical)."""
+        plen = int(req.prompt.size)
+        if req.mode == "beam":
+            key = ("beam", req.max_new_tokens, req.n_beams, plen)
+            fn = self._special_fns.get(key)
+            if fn is None:
+                from rayfed_tpu.models import decode
+
+                fn = decode.make_beam_search_fn(
+                    self.cfg,
+                    max_new_tokens=req.max_new_tokens,
+                    n_beams=req.n_beams,
+                    eos_id=self.scfg.eos_id,
+                )
+                self._special_fns[key] = fn
+            seqs, scores = fn(params, req.prompt[None])
+            seqs = np.asarray(seqs)
+            req.out = [int(t) for t in seqs[0, 0, plen:]]
+            req.extra_resp["scores"] = [
+                float(s) for s in np.asarray(scores)[0]
+            ]
+        else:
+            draft_params = self.bank.get_extra(req.version, "draft_params")
+            if draft_params is None:
+                raise ValueError(
+                    "mode='speculative' needs publish(..., draft_params=...)"
+                )
+            from rayfed_tpu.models import speculative
+
+            key = ("spec", req.max_new_tokens, plen)
+            fn = self._special_fns.get(key)
+            if fn is None:
+                fn = speculative.make_speculative_generate_fn(
+                    self.cfg,
+                    self.draft_cfg,
+                    max_new_tokens=req.max_new_tokens,
+                    eos_id=self.scfg.eos_id,
+                )
+                self._special_fns[key] = fn
+            out = fn(params, draft_params, req.prompt[None])
+            req.out = [int(t) for t in np.asarray(out)[0, plen:]]
+        now = time.perf_counter()
+        req.timing["prefill"] = now
+        req.timing["first_token"] = now
+        tracing.record_request(req.rid, "first_token", t_s=now)
+        self._finish(req)
+
+
+# -- process-local server registry (one per serve() name) --------------------
+
+_registry_lock = threading.Lock()
+_servers: Dict[str, InferenceServer] = {}
+
+
+def register_server(server: InferenceServer) -> None:
+    with _registry_lock:
+        old = _servers.get(server.name)
+        if old is not None and old is not server:
+            raise ValueError(
+                f"a server named {server.name!r} is already registered; "
+                "stop it first or pick another name"
+            )
+        _servers[server.name] = server
+
+
+def get_server(name: str = "default") -> InferenceServer:
+    with _registry_lock:
+        server = _servers.get(name)
+    if server is None:
+        raise RuntimeError(
+            f"no serving engine named {name!r} on this party — "
+            "fed.serve() must run (with this party as the host) first"
+        )
+    return server
+
+
+def unregister_server(name: str) -> None:
+    with _registry_lock:
+        _servers.pop(name, None)
+
+
+def stop_all_servers(timeout: float = 10.0) -> None:
+    """Teardown hook for fed.shutdown(): stop every registered engine."""
+    with _registry_lock:
+        servers = list(_servers.values())
+        _servers.clear()
+    for server in servers:
+        try:
+            server.stop(timeout)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            logger.exception("serving[%s]: stop failed", server.name)

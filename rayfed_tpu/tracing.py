@@ -37,6 +37,8 @@ _use_jax_annotations = False
 _lock = threading.Lock()
 _MAX_SPANS = 10000
 _spans: Deque["Span"] = deque(maxlen=_MAX_SPANS)
+_MAX_REQUEST_EVENTS = 20000
+_request_events: Deque["RequestEvent"] = deque(maxlen=_MAX_REQUEST_EVENTS)
 
 
 @dataclass
@@ -72,6 +74,7 @@ def is_enabled() -> bool:
 def clear() -> None:
     with _lock:
         _spans.clear()
+        _request_events.clear()
 
 
 def get_spans(kind: Optional[str] = None) -> List[Span]:
@@ -202,6 +205,87 @@ def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
                 ok=ok,
             )
         )
+
+
+# -- per-request serving timeline (docs/serving.md) -------------------------
+#
+# The serving plane's observability slice (ROADMAP "production
+# observability"): each request leaves a breadcrumb trail of lifecycle
+# events — enqueue / admit / prefill / first_token / step / finish — in a
+# second bounded ring, exportable as JSON next to the per-seq-id wire
+# timeline so a slow request is diagnosable from artifacts alone (which
+# phase ate the time, which model version served it, whether it waited in
+# admission or in the decode batch).
+
+
+@dataclass
+class RequestEvent:
+    request_id: str
+    event: str                # "enqueue" | "prefill" | "first_token" | ...
+    t_s: float                # perf_counter timestamp
+    extra: Dict = field(default_factory=dict)
+
+
+def record_request(request_id: str, event: str,
+                   t_s: Optional[float] = None, **extra) -> None:
+    """Append one lifecycle event for ``request_id`` (no-op when tracing
+    is off, like every recorder in this module)."""
+    if not _enabled:
+        return
+    if t_s is None:
+        t_s = time.perf_counter()
+    with _lock:
+        _request_events.append(
+            RequestEvent(str(request_id), event, t_s, dict(extra))
+        )
+
+
+def get_request_events(
+    request_id: Optional[str] = None,
+) -> List[RequestEvent]:
+    with _lock:
+        events = list(_request_events)
+    if request_id is not None:
+        events = [e for e in events if e.request_id == str(request_id)]
+    return events
+
+
+def request_timelines() -> Dict[str, List[RequestEvent]]:
+    """Events grouped per request id, time-ordered within each."""
+    out: Dict[str, List[RequestEvent]] = {}
+    for e in get_request_events():
+        out.setdefault(e.request_id, []).append(e)
+    for events in out.values():
+        events.sort(key=lambda e: e.t_s)
+    return out
+
+
+def export_request_timeline(path: str, party: str = "") -> int:
+    """Write the per-request serving timeline as JSON:
+    ``{"party", "requests": {id: [{"event", "t_s", ...extra}]}}`` with
+    per-request events time-ordered. Returns the number of events
+    written. Lives alongside :func:`export_timeline` (the per-seq-id wire
+    artifact); same snapshot discipline — safe to call from a watchdog
+    signal handler (non-blocking lock attempt, ring iterated without it
+    at worst losing the in-flight event)."""
+    import json
+
+    acquired = _lock.acquire(blocking=False)
+    try:
+        events = list(_request_events)
+    finally:
+        if acquired:
+            _lock.release()
+    requests: Dict[str, List[Dict]] = {}
+    n = 0
+    for e in sorted(events, key=lambda e: (e.request_id, e.t_s)):
+        requests.setdefault(e.request_id, []).append(
+            {"event": e.event, "t_s": e.t_s, **e.extra}
+        )
+        n += 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"party": party or "?", "requests": requests}, f)
+    return n
 
 
 class span:

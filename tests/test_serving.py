@@ -1,0 +1,551 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Serving-plane tests (docs/serving.md).
+
+The load-bearing guarantees:
+ - a hot swap mid-decode never aborts an in-flight request;
+ - every response is produced entirely by exactly one model version
+   (proved by matching each response bit-for-bit against a single-version
+   reference generation);
+ - fixed-seed output is bitwise-stable when no swap occurs;
+ - continuous batching and the slot pool never mix rows (a request's
+   output is independent of what shares its batch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from rayfed_tpu import tracing  # noqa: E402
+from rayfed_tpu.config import ServingConfig  # noqa: E402
+from rayfed_tpu.models import decode  # noqa: E402
+from rayfed_tpu.models import transformer as tfm  # noqa: E402
+from rayfed_tpu.serving.kv_pool import KVPool  # noqa: E402
+from rayfed_tpu.serving.publish import ModelBank  # noqa: E402
+from rayfed_tpu.serving.server import (  # noqa: E402
+    InferenceServer,
+    ServerOverloadedError,
+    ServerStoppedError,
+)
+
+CFG = tfm.tiny_config(compute_dtype=jnp.float32)
+PARAMS_A = tfm.init_params(jax.random.PRNGKey(0), CFG)
+PARAMS_B = tfm.init_params(jax.random.PRNGKey(1), CFG)
+
+
+def _server(**overrides):
+    kwargs = dict(max_slots=4, max_len=32, max_new_tokens=8)
+    kwargs.update(overrides)
+    return InferenceServer(CFG, ServingConfig(**kwargs), params=PARAMS_A)
+
+
+def _reference(params, prompt, max_new):
+    gen = decode.make_generate_fn(CFG, max_new_tokens=max_new)
+    out = np.asarray(gen(params, np.asarray(prompt, np.int32)[None]))
+    return [int(t) for t in out[0, len(prompt):]]
+
+
+# ---------------------------------------------------------------------------
+# KV pool
+
+
+def test_pool_acquire_release_cycle():
+    pool = KVPool(CFG, max_slots=2, max_len=8)
+    a, b = pool.acquire(), pool.acquire()
+    assert {a, b} == {0, 1}
+    assert pool.acquire() is None
+    pool.release(a)
+    assert pool.acquire() == a
+    with pytest.raises(ValueError):
+        pool.release(b) or pool.release(b)
+
+
+def test_pool_prefix_index_dropped_on_release():
+    pool = KVPool(CFG, max_slots=2, max_len=8)
+    slot = pool.acquire()
+    pool.note_prefix(slot, 1, b"abc")
+    assert pool.lookup_prefix(1, b"abc") == slot
+    assert pool.lookup_prefix(2, b"abc") is None  # version-scoped
+    pool.release(slot)
+    assert pool.lookup_prefix(1, b"abc") is None
+
+
+def test_pool_allocates_sacrificial_position():
+    pool = KVPool(CFG, max_slots=2, max_len=8)
+    k, _ = pool.kv
+    assert k.shape[2] == 9
+    assert pool.junk_pos == 8
+
+
+# ---------------------------------------------------------------------------
+# Model bank
+
+
+def test_bank_swap_is_atomic_and_refcounted():
+    bank = ModelBank()
+    with pytest.raises(RuntimeError):
+        bank.acquire()
+    v1 = bank.publish(PARAMS_A)
+    ver, params = bank.acquire()
+    assert (v1, ver) == (1, 1)
+    v2 = bank.publish(PARAMS_B)
+    assert v2 == 2
+    # v1 pinned by the in-flight request: still resolvable.
+    assert bank.live_versions() == [1, 2]
+    np.testing.assert_array_equal(
+        np.asarray(bank.get(1)["embed"]), np.asarray(params["embed"])
+    )
+    bank.release(1)
+    assert bank.live_versions() == [2]
+
+
+def test_bank_snapshot_survives_caller_donation():
+    bank = ModelBank()
+    tree = {"w": jnp.arange(8, dtype=jnp.float32)}
+    bank.publish(tree)
+    # The trainer immediately feeds the same buffers to a donating step;
+    # the bank's snapshot must not alias them.
+    jax.jit(lambda x: {"w": x["w"] * 0}, donate_argnums=0)(tree)
+    _, snap = bank.acquire()
+    np.testing.assert_array_equal(
+        np.asarray(snap["w"]), np.arange(8, dtype=np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine: correctness of continuous batching
+
+
+def test_single_request_matches_generate_fn():
+    srv = _server()
+    try:
+        prompt = list(range(5, 15))
+        resp = srv.submit_and_wait(prompt, max_new_tokens=6)
+        assert resp["tokens"] == _reference(PARAMS_A, prompt, 6)
+        assert resp["version"] == 1
+        assert resp["prompt_len"] == 10
+    finally:
+        srv.stop()
+
+
+def test_batched_rows_do_not_mix():
+    """Distinct concurrent prompts each match their own solo reference —
+    the vmapped pool step keeps rows independent."""
+    srv = _server()
+    try:
+        prompts = [list(range(i, i + 6)) for i in range(1, 9)]
+        futs = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=120)["tokens"] == _reference(
+                PARAMS_A, p, 5
+            )
+        assert srv.stats()["completed"] == 8
+    finally:
+        srv.stop()
+
+
+def test_eos_exits_early_without_draining_batch():
+    prompt = list(range(5, 15))
+    ref = _reference(PARAMS_A, prompt, 8)
+    eos = ref[2]  # greedy path is deterministic, so this token WILL appear
+    srv = _server(eos_id=eos)
+    try:
+        resp = srv.submit_and_wait(prompt, max_new_tokens=8)
+        first_eos = ref.index(eos)
+        assert resp["tokens"] == ref[: first_eos + 1]
+        assert len(resp["tokens"]) < 8
+    finally:
+        srv.stop()
+
+
+def test_fixed_seed_output_bitwise_stable_without_swap():
+    """Same workload, same seeds, two engine lifetimes -> identical
+    tokens, byte for byte (the acceptance-criteria determinism claim)."""
+    prompts = [list(range(i, i + 8)) for i in range(1, 7)]
+
+    def run_once():
+        srv = _server(temperature=0.7)
+        try:
+            futs = [
+                srv.submit(p, max_new_tokens=6, seed=17 + i)
+                for i, p in enumerate(prompts)
+            ]
+            return [f.result(timeout=120)["tokens"] for f in futs]
+        finally:
+            srv.stop()
+
+    assert run_once() == run_once()
+
+
+def test_prefix_reuse_hits_and_matches_full_prefill():
+    srv = _server()
+    try:
+        prompt = list(range(7, 17))
+        futs = [srv.submit(prompt, max_new_tokens=6) for _ in range(4)]
+        outs = [f.result(timeout=120) for f in futs]
+        ref = _reference(PARAMS_A, prompt, 6)
+        for resp in outs:
+            assert resp["tokens"] == ref
+        assert srv.stats()["prefix_hits"] >= 1
+        assert any(r["prefix_reuse"] for r in outs)
+    finally:
+        srv.stop()
+
+
+def test_admission_control_rejects_when_full():
+    # max_slots=1 + tiny queue: flood and expect loud rejections.
+    srv = _server(max_slots=1, max_pending=2)
+    try:
+        futs, rejected = [], 0
+        for i in range(30):
+            try:
+                futs.append(srv.submit([1, 2, 3, 4], max_new_tokens=8))
+            except ServerOverloadedError:
+                rejected += 1
+        assert rejected >= 1
+        for f in futs:
+            f.result(timeout=120)
+        assert srv.stats()["rejected"] == rejected
+    finally:
+        srv.stop()
+
+
+def test_submit_after_stop_raises():
+    srv = _server()
+    srv.stop()
+    with pytest.raises(ServerStoppedError):
+        srv.submit([1, 2, 3])
+
+
+def test_bad_request_fails_its_future_not_the_engine():
+    srv = _server()
+    try:
+        with pytest.raises(ValueError):
+            srv.submit([], max_new_tokens=4)          # empty prompt
+        with pytest.raises(ValueError):
+            srv.submit(list(range(30)), max_new_tokens=8)  # over max_len
+        # Engine still serves.
+        resp = srv.submit_and_wait([1, 2, 3], max_new_tokens=3)
+        assert len(resp["tokens"]) == 3
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Hot swap under load
+
+
+def test_swap_mid_decode_never_aborts_and_never_mixes_versions():
+    """The tentpole guarantee: publish lands while 8+ requests are in
+    flight; every request completes, and each one's tokens equal the
+    single-version reference for the version it pinned at admission —
+    any torn tree or cross-version cache/params mixing would break the
+    bit-for-bit match."""
+    srv = _server(max_slots=4, max_len=48, max_new_tokens=16)
+
+    def wait_admitted(n, timeout=60):
+        # Publish only once >= n requests were ADMITTED (slot claimed,
+        # version pinned) so the swap provably lands mid-decode — the
+        # engine races the publisher, and a publish that wins before any
+        # admission would let every request pin the newest version.
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = srv.stats()
+            if s["active"] + s["completed"] >= n:
+                return
+            time.sleep(0.002)
+        raise AssertionError("engine never admitted the load")
+
+    try:
+        prompt = list(range(3, 13))
+        futs = [
+            srv.submit(prompt, max_new_tokens=12, seed=i) for i in range(8)
+        ]
+        # Land swaps while the batch decodes.
+        wait_admitted(1)  # someone pinned v1
+        v2 = srv.publish(PARAMS_B)
+        futs += [
+            srv.submit(prompt, max_new_tokens=12, seed=50 + i)
+            for i in range(8)
+        ]
+        wait_admitted(9)  # someone from the second wave pinned v2
+        v3 = srv.publish(PARAMS_A)
+        futs += [srv.submit(prompt, max_new_tokens=12, seed=99)]
+        assert (v2, v3) == (2, 3)
+
+        resps = [f.result(timeout=240) for f in futs]  # zero aborts
+        assert len(resps) == 17
+        refs = {
+            1: _reference(PARAMS_A, prompt, 12),
+            2: _reference(PARAMS_B, prompt, 12),
+            3: _reference(PARAMS_A, prompt, 12),
+        }
+        seen = set()
+        for resp in resps:
+            assert resp["tokens"] == refs[resp["version"]], resp["version"]
+            seen.add(resp["version"])
+        assert len(seen) >= 2, "swap window never overlapped the load"
+        # Retirement: nothing pins v1/v2 anymore.
+        assert srv.bank.live_versions() == [3]
+        assert srv.stats()["swaps"] == 3
+    finally:
+        srv.stop()
+
+
+def test_concurrent_publishers_and_clients():
+    """Swaps from a foreign thread while client threads hammer submit:
+    exercises the admission/publish locking. Every response must still
+    match one single-version reference exactly."""
+    srv = _server(max_slots=4, max_len=48, max_new_tokens=16,
+                  max_pending=256)
+    try:
+        prompt = list(range(4, 12))
+        refs = {
+            1: _reference(PARAMS_A, prompt, 8),
+            2: _reference(PARAMS_B, prompt, 8),
+            3: _reference(PARAMS_A, prompt, 8),
+        }
+        results, errors = [], []
+
+        def client(n):
+            try:
+                for _ in range(n):
+                    results.append(srv.submit_and_wait(prompt,
+                                                       max_new_tokens=8))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(4,))
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        srv.publish(PARAMS_B)
+        time.sleep(0.3)
+        srv.publish(PARAMS_A)
+        for t in threads:
+            t.join(timeout=240)
+        assert not errors, errors
+        assert len(results) == 32
+        for resp in results:
+            assert resp["tokens"] == refs[resp["version"]]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Whole-request modes ride the same swap semantics
+
+
+def test_beam_request_matches_beam_search_fn():
+    srv = _server(max_len=48)
+    try:
+        prompt = list(range(5, 15))
+        resp = srv.submit_and_wait(prompt, max_new_tokens=4, mode="beam",
+                                   n_beams=3)
+        fn = decode.make_beam_search_fn(CFG, max_new_tokens=4, n_beams=3)
+        seqs, scores = fn(PARAMS_A, np.asarray(prompt, np.int32)[None])
+        assert resp["tokens"] == [
+            int(t) for t in np.asarray(seqs)[0, 0, len(prompt):]
+        ]
+        assert resp["scores"] == pytest.approx(
+            [float(s) for s in np.asarray(scores)[0]]
+        )
+    finally:
+        srv.stop()
+
+
+def test_speculative_request_served():
+    draft_cfg = tfm.tiny_config(
+        compute_dtype=jnp.float32, d_model=32, n_heads=2, n_layers=1,
+        d_ff=64,
+    )
+    draft_params = tfm.init_params(jax.random.PRNGKey(7), draft_cfg)
+    srv = InferenceServer(
+        CFG,
+        ServingConfig(max_slots=2, max_len=48, max_new_tokens=8),
+        draft_cfg=draft_cfg,
+    )
+    try:
+        srv.publish(PARAMS_A, draft_params=draft_params)
+        prompt = list(range(5, 15))
+        resp = srv.submit_and_wait(prompt, max_new_tokens=6,
+                                   mode="speculative")
+        # Greedy speculative decode is bit-for-bit the target's greedy.
+        assert resp["tokens"] == _reference(PARAMS_A, prompt, 6)
+    finally:
+        srv.stop()
+
+
+def test_speculative_without_draft_rejected_at_submit():
+    srv = _server()
+    try:
+        with pytest.raises(ValueError, match="draft_cfg"):
+            srv.submit([1, 2, 3], mode="speculative")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Request timeline tracing
+
+
+def test_request_timeline_export(tmp_path):
+    tracing.clear()
+    tracing.enable()
+    try:
+        srv = _server()
+        try:
+            resp = srv.submit_and_wait(list(range(5, 12)),
+                                       max_new_tokens=4)
+        finally:
+            srv.stop()
+        rid = resp["request_id"]
+        events = [e.event for e in tracing.get_request_events(rid)]
+        for needed in ("enqueue", "admit", "prefill", "first_token",
+                       "finish"):
+            assert needed in events, (needed, events)
+        timeline = tracing.request_timelines()[rid]
+        times = [e.t_s for e in timeline]
+        assert times == sorted(times)
+
+        path = str(tmp_path / "requests.json")
+        n = tracing.export_request_timeline(path, party="alice")
+        assert n >= 5
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["party"] == "alice"
+        assert [e["event"] for e in doc["requests"][rid]] == events
+    finally:
+        tracing.disable()
+        tracing.clear()
+
+
+def test_request_timeline_noop_when_disabled():
+    tracing.clear()
+    srv = _server()
+    try:
+        srv.submit_and_wait([1, 2, 3], max_new_tokens=2)
+        assert tracing.get_request_events() == []
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sequential (naive) mode — the bench baseline uses the same engine
+
+
+def test_sequential_mode_serves_one_at_a_time():
+    srv = _server(mode="sequential")
+    try:
+        prompts = [list(range(i, i + 6)) for i in range(1, 5)]
+        futs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=120)["tokens"] == _reference(
+                PARAMS_A, p, 4
+            )
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Executor opt-out (the serving submit path depends on it)
+
+
+def test_executor_eager_false_goes_to_pool():
+    from rayfed_tpu._private.executor import LocalExecutor
+
+    ex = LocalExecutor(max_workers=2)
+    try:
+        started = threading.Event()
+        release = threading.Event()
+
+        def blocker():
+            started.set()
+            release.wait(30)
+            return "done"
+
+        # eager=True would run this inline and deadlock the caller here;
+        # eager=False must return a pending future immediately.
+        fut = ex.submit(blocker, (), {}, eager=False)
+        assert started.wait(10)
+        assert not fut.done()
+        release.set()
+        assert fut.result(10) == "done"
+    finally:
+        ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Two-party e2e: fed.serve on alice, submits from both drivers, a hot
+# swap whose params arrive as an owner-push over the wire from bob.
+
+from tests.utils import FAST_COMM_CONFIG, run_parties  # noqa: E402
+
+import rayfed_tpu as fed  # noqa: E402
+
+CONFIG = {
+    "cross_silo_comm": dict(FAST_COMM_CONFIG),
+    "serving": {"max_slots": 4, "max_len": 48, "max_new_tokens": 8},
+}
+
+
+@fed.remote
+def _fresh_params(seed):
+    return tfm.init_params(jax.random.PRNGKey(seed), CFG)
+
+
+def run_serve_two_party(party, addresses):
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    handle = fed.serve("alice", CFG, params=PARAMS_A)
+    prompt = list(range(5, 13))
+
+    futs = [handle.submit(prompt, max_new_tokens=6, seed=i)
+            for i in range(4)]
+    # Swap mid-flight; the new tree is produced AT BOB, so the publish is
+    # an owner-push of the param tree over the bulk lane.
+    v2 = handle.publish(_fresh_params.party("bob").remote(1))
+    futs += [handle.submit(prompt, max_new_tokens=6, seed=10 + i)
+             for i in range(2)]
+
+    resps = [fed.get(f) for f in futs]
+    assert fed.get(v2) == 2
+    refs = {
+        1: _reference(PARAMS_A, prompt, 6),
+        2: _reference(PARAMS_B, prompt, 6),
+    }
+    for resp in resps:  # zero aborts; one version end to end, each
+        assert resp["tokens"] == refs[resp["version"]], resp["version"]
+
+    stats = fed.get(handle.stats())
+    assert stats["completed"] >= 6
+    assert stats["current_version"] == 2
+    assert fed.get(handle.shutdown()) is True
+    fed.shutdown()
+
+
+def test_serve_two_party_e2e():
+    run_parties(run_serve_two_party, ["alice", "bob"])

@@ -16,8 +16,10 @@
 """Self-signed CA + per-party certificate generator for tests/demos.
 
 Capability parity: reference ``tool/generate_tls_certs.py`` (129 LoC,
-openssl-subprocess based). This version uses the ``cryptography`` package
-directly so it runs anywhere the framework does.
+openssl-subprocess based). This version prefers the ``cryptography``
+package (runs anywhere the framework does) and falls back to the
+``openssl`` CLI — the reference's own mechanism — when the package is
+not installed, so TLS tests still run on minimal images.
 
 Usage:
     python tools/generate_tls_certs.py OUTPUT_DIR [party ...]
@@ -32,10 +34,65 @@ from __future__ import annotations
 import datetime
 import ipaddress
 import os
+import shutil
+import subprocess
 import sys
+import tempfile
 
 
 def generate(output_dir: str, parties) -> None:
+    try:
+        import cryptography  # noqa: F401
+    except ImportError:
+        if shutil.which("openssl") is None:
+            raise RuntimeError(
+                "TLS cert generation needs either the 'cryptography' "
+                "package or the 'openssl' CLI; neither is available"
+            ) from None
+        _generate_openssl(output_dir, parties)
+        return
+    _generate_cryptography(output_dir, parties)
+
+
+def _generate_openssl(output_dir: str, parties) -> None:
+    """The reference's subprocess path: one self-signed CA, one CSR +
+    CA-signed cert per party, SANs for loopback."""
+
+    def run(*args, **kw):
+        subprocess.run(
+            ["openssl", *args], check=True, capture_output=True, **kw
+        )
+
+    os.makedirs(output_dir, exist_ok=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        ca_key = os.path.join(tmp, "ca.key")
+        ca_crt = os.path.join(output_dir, "ca.crt")
+        run(
+            "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", ca_key, "-out", ca_crt, "-days", "365",
+            "-subj", "/CN=rayfed-tpu-test-ca",
+        )
+        ext = os.path.join(tmp, "san.cnf")
+        with open(ext, "w") as f:
+            f.write("subjectAltName=DNS:localhost,IP:127.0.0.1\n")
+        for party in parties:
+            pdir = os.path.join(output_dir, party)
+            os.makedirs(pdir, exist_ok=True)
+            csr = os.path.join(tmp, f"{party}.csr")
+            run(
+                "req", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", os.path.join(pdir, "key.pem"),
+                "-out", csr, "-subj", f"/CN={party}",
+            )
+            run(
+                "x509", "-req", "-in", csr, "-CA", ca_crt,
+                "-CAkey", ca_key, "-CAcreateserial",
+                "-out", os.path.join(pdir, "cert.pem"),
+                "-days", "365", "-extfile", ext,
+            )
+
+
+def _generate_cryptography(output_dir: str, parties) -> None:
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -1,0 +1,121 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Serving-plane regression gate (docs/serving.md).
+
+Runs bench.py's serve stage — one InferenceServer under 8 concurrent
+client threads, hot swaps landing strictly mid-window, plus the same
+workload in naive one-request-at-a-time mode — and FAILS LOUDLY, exit
+code 1, when a serving guarantee regresses. Wire this into CI so a
+change that quietly serializes the continuous batcher, drops batch
+occupancy, or stalls requests across a hot swap turns the build red
+instead of shipping.
+
+Gates (on the bench keys; budgets generous vs the ~1350 tok/s /
+~850 ms p99 / ~2x speedup measured on the 1-core CI host class, so
+host noise does not flake them — tighten on dedicated hardware):
+
+  FEDTPU_SERVE_BUDGET_TOKENS_S  default 300.0 — floor on the median
+                                ``serve_tokens_s``. A lost batched step
+                                (back to one-request-at-a-time decode)
+                                lands well below it.
+  FEDTPU_SERVE_BUDGET_P99_MS    default 5000.0 — ceiling on the median
+                                ``serve_p99_ms``. A request stalled by a
+                                hot swap (the bug the pinned-version
+                                design makes impossible) blows past it.
+  FEDTPU_SERVE_BUDGET_SPEEDUP   default 1.5 — floor on
+                                ``serve_batching_speedup`` (continuous
+                                vs sequential admission on the SAME
+                                engine; measured ~2.0x). Broken
+                                continuous batching degenerates to
+                                ~1.0x, cleanly below the floor.
+  FEDTPU_BENCH_SERVE_CLIENTS / _REQS / _REPS — forwarded to the bench
+                                stage (defaults 8 / 4 / 3).
+
+The swap requirement is not tunable: every continuous window must have
+landed >= 1 hot swap mid-flight (``serve_swaps`` >= 1) or the
+measurement did not exercise the publish path at all.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    tokens_floor = float(
+        os.environ.get("FEDTPU_SERVE_BUDGET_TOKENS_S", "300.0")
+    )
+    p99_ceiling = float(os.environ.get("FEDTPU_SERVE_BUDGET_P99_MS", "5000.0"))
+    speedup_floor = float(
+        os.environ.get("FEDTPU_SERVE_BUDGET_SPEEDUP", "1.5")
+    )
+
+    res = bench._run_serve_bench()
+    for k in sorted(res):
+        print(f"{k}={res[k]}", flush=True)
+
+    failures = []
+    if res["serve_swaps"] < 1:
+        failures.append(
+            "serve_swaps=0: no hot swap landed while requests were in "
+            "flight — the window drained before the publisher fired, so "
+            "the swap path went unmeasured. Check the publisher "
+            "thresholds in bench._serve_bench_entry."
+        )
+    if res["serve_tokens_s"] < tokens_floor:
+        failures.append(
+            f"SERVING REGRESSION: serve_tokens_s={res['serve_tokens_s']} "
+            f"below the {tokens_floor} floor. The batched pool step is "
+            f"the usual suspect: check that _step_groups still runs ONE "
+            f"vmapped step per live version per iteration and that "
+            f"admission still fills free slots without draining the "
+            f"batch. spread={res['serve_tokens_s_spread']}"
+        )
+    if res["serve_p99_ms"] > p99_ceiling:
+        failures.append(
+            f"SERVING REGRESSION: serve_p99_ms={res['serve_p99_ms']} "
+            f"exceeds the {p99_ceiling} ms ceiling. Check for requests "
+            f"stalled across a hot swap (version pinning must keep them "
+            f"decoding) and for admission starvation under load. "
+            f"spread={res['serve_p99_ms_spread']}"
+        )
+    if res["serve_batching_speedup"] < speedup_floor:
+        failures.append(
+            f"SERVING REGRESSION: serve_batching_speedup="
+            f"{res['serve_batching_speedup']} below the {speedup_floor} "
+            f"floor vs naive one-at-a-time serving "
+            f"(serve_naive_tokens_s={res['serve_naive_tokens_s']}). "
+            f"Continuous batching has degenerated — prefill-then-merge "
+            f"at token boundaries and early-exit of finished sequences "
+            f"are the usual suspects."
+        )
+
+    if failures:
+        for msg in failures:
+            print(msg, file=sys.stderr)
+        return 1
+    print("serve gate passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
