@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma serve clean
+.PHONY: native test lint chaos latency scale dma serve async clean
 
 native:
 	python setup.py build_ext --inplace
@@ -58,6 +58,17 @@ dma:
 # .github/workflows/tests.yml.
 serve:
 	JAX_PLATFORMS=cpu python tools/serve_check.py
+
+# Async gate (docs/async_rounds.md): 3 spawned parties with carol's
+# every send delayed by a seeded fault schedule; buffered-async rounds
+# (fed.async_round, K-publish without the straggler) must sustain
+# FEDTPU_ASYNC_BUDGET_RATIO x (default 3.0) the lock-step baseline's
+# rounds/s AND an absolute FEDTPU_ASYNC_BUDGET_FLOOR — a change that
+# re-serializes the fold path or makes publish wait for the straggler
+# fails loudly here. Mirrors the `async` job in
+# .github/workflows/tests.yml.
+async:
+	JAX_PLATFORMS=cpu python tools/async_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

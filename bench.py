@@ -106,6 +106,11 @@ def _party_entry(target, party, *rest):
                     tracing.export_timeline(
                         os.path.join(d, f"{party}.timeline"), party
                     )
+                    # Structured twin: feed to tools/trace_view.py for
+                    # a per-seq-id text flamegraph of the wedge.
+                    tracing.export_seq_timeline(
+                        os.path.join(d, f"{party}.seq.json"), party
+                    )
                 except OSError:
                     pass
 
@@ -1419,6 +1424,125 @@ def _cnn_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_ASYNC3 = ("alice", "bob", "carol")
+
+
+def _async_party(party, addresses, transport, result_path, rounds):
+    """Straggler-proof sustained throughput (docs/async_rounds.md): 3
+    parties, every frame carol sends delayed by a seeded fault schedule
+    (``resilience.inject``). Each repetition runs the same contribution
+    workload through two windows: lock-step ``fed_aggregate`` rounds
+    (every round waits out carol's delay — the stall async mode exists
+    to remove) and buffered-async rounds (``fed.async_round``,
+    buffer_k=2: alice+bob publish immediately; carol's late pushes fold
+    in with staleness decay). ``async_rounds_s`` vs ``sync_rounds_s`` is
+    the headline ratio tools/async_check.py gates (>= 3x)."""
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.async_rounds import async_session_stats
+    from rayfed_tpu.federated import fed_aggregate
+
+    delay_ms = int(os.environ.get("FEDTPU_BENCH_ASYNC_DELAY_MS", "400"))
+    reps = int(os.environ.get("FEDTPU_BENCH_ASYNC_REPS", "2"))
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": dict(_FAST_RETRY),
+            "transport": transport,
+            "resilience": {
+                "fault_schedule": {
+                    "seed": 9,
+                    "rules": [{
+                        "fault": "delay",
+                        "src": "carol",
+                        "prob": 1.0,
+                        "max_delay_ms": delay_ms,
+                    }],
+                },
+            },
+        },
+        job_name=f"bench-async-{transport}",
+        logging_level="error",
+    )
+    n_elem = 1 << 14  # 64KB float32 gradient tree per contribution
+    seeds = {"alice": 1.0, "bob": 2.0, "carol": 3.0}
+
+    @fed.remote
+    def contrib(seed, r):
+        return {"g": np.full((n_elem,), float(seed + r), np.float32)}
+
+    def sync_window(tag):
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            objs = {
+                p: contrib.party(p).remote(seeds[p], r) for p in _ASYNC3
+            }
+            val = fed.get(fed_aggregate(objs, op="mean"))
+            assert np.isfinite(np.asarray(val["g"]).sum())
+        return time.perf_counter() - t0
+
+    def async_window(tag):
+        session = f"bench{tag}"
+        handles = []
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            objs = {
+                p: contrib.party(p).remote(seeds[p], r) for p in _ASYNC3
+            }
+            handles.append(fed.async_round(
+                objs, round_tag=r, buffer_k=2, session=session,
+                fetch_model=False,
+            ))
+        # The window ends when `rounds` K-publishes landed — alice+bob
+        # fill each buffer without waiting for carol. Every driver polls
+        # the SAME broadcast stats, so every driver exits the loop on
+        # the same iteration (multi-controller contract).
+        deadline = t0 + max(60.0, rounds * delay_ms / 1000.0 * 3)
+        while True:
+            stats = fed.get(async_session_stats("alice", session))
+            if stats["publishes"] >= rounds:
+                break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"async window stalled: {stats}")
+            time.sleep(0.02)
+        dt = time.perf_counter() - t0
+        assert stats["version"] >= rounds
+        # Drain carol's in-flight straggler offers BEFORE any party
+        # reaches fed.shutdown(): the delayed frames ride daemon timer
+        # threads, so a party exiting early would strand alice's
+        # pending offer tasks on blocked pool workers (exit-time hang).
+        # Outside the timed window — the window ends at the K-publish.
+        for h in handles:
+            fed.get(list(h.offers.values()))
+        return dt
+
+    # Warmup round: dial + jit of the fold programs, outside both windows.
+    _progress(party, "init done; warmup")
+    warm = {p: contrib.party(p).remote(seeds[p], 0) for p in _ASYNC3}
+    fed.get(fed_aggregate(warm, op="mean"))
+    sync_s, async_s = [], []
+    for rep in range(reps):
+        _progress(party, f"rep {rep + 1}/{reps}: sync window")
+        sync_s.append(rounds / sync_window(rep))
+        _progress(party, f"rep {rep + 1}/{reps}: async window")
+        async_s.append(rounds / async_window(rep))
+    _progress(party, "windows done; shutting down")
+    if party == "alice":
+        best_async, best_sync = max(async_s), max(sync_s)
+        with open(result_path, "w") as f:
+            json.dump({
+                "async_rounds_s": best_async,
+                "sync_rounds_s": best_sync,
+                "async_rounds_s_spread": async_s,
+                "sync_rounds_s_spread": sync_s,
+                "async_vs_sync": best_async / best_sync,
+                "straggler_delay_ms": delay_ms,
+            }, f)
+    fed.shutdown()
+
+
 def _try_build_fastwire() -> None:
     """Best-effort build of the native C++ IO lane; the transport falls
     back to pure-Python sockets if this fails."""
@@ -1637,6 +1761,20 @@ def main() -> None:
     result.update(_bench_stage(
         _cnn_party, "round_ms", "FEDTPU_BENCH_CNN_ROUNDS", 5,
         [("tcp", "fedavg_cnn_round_ms")], cpu_force=True, timeout_s=420,
+    ))
+    # Straggler-proof async rounds (docs/async_rounds.md): carol's sends
+    # delayed by a seeded fault schedule; sync stalls, buffered-async
+    # sustains. tools/async_check.py gates the ratio.
+    result.update(_bench_stage(
+        _async_party, "async_rounds_s", "FEDTPU_BENCH_ASYNC_ROUNDS", 12,
+        [("tcp", "async_rounds_s")], cpu_force=True, parties=_ASYNC3,
+        timeout_s=420,
+        extra_fields={
+            "sync_rounds_s": "sync_rounds_s",
+            "async_rounds_s_spread": "async_rounds_s_spread",
+            "sync_rounds_s_spread": "sync_rounds_s_spread",
+            "async_vs_sync": "async_vs_sync",
+        },
     ))
     # N-party scale sweep (in-process simulated parties, real wire edges).
     try:

@@ -45,6 +45,10 @@ from rayfed_tpu.serving import (  # noqa: F401
     serve,
     submit_request,
 )
+from rayfed_tpu.async_rounds import (  # noqa: F401  (after api import)
+    AsyncRoundHandle,
+    async_round,
+)
 
 __version__ = "0.1.0"
 
@@ -66,5 +70,7 @@ __all__ = [
     "serve",
     "submit_request",
     "ServeHandle",
+    "async_round",
+    "AsyncRoundHandle",
     "__version__",
 ]

@@ -322,6 +322,12 @@ def init(
             aggregation_dict.get("topology", "auto"),
             group_size=aggregation_dict.get("group_size"),
         )
+        # Async-mode job defaults (aggregation.async_* keys,
+        # docs/async_rounds.md) — validated eagerly so a typo'd key or
+        # out-of-range value rejects init, not the first async round.
+        from rayfed_tpu import async_rounds as _async_rounds
+
+        _async_rounds.set_default_async_config(aggregation_dict)
 
     # Serving-plane job defaults (docs/serving.md): stored like the
     # aggregation topology default — every driver reads the same dict, so
@@ -409,6 +415,12 @@ def _shutdown(intended: bool = True):
     from rayfed_tpu import topology as _topology
 
     _topology.reset_default()
+    # Async aggregation sessions hold buffered contribution trees and
+    # per-session version counters; a new job must not fold into them.
+    _async_rounds = sys.modules.get("rayfed_tpu.async_rounds")
+    if _async_rounds is not None:
+        _async_rounds.reset_sessions()
+        _async_rounds.reset_default_async_config()
     # Serving engines hold jitted programs and a live thread; stop them
     # before the proxies so a submit task in flight fails loudly instead
     # of wedging teardown. Only touch the module if something imported it
@@ -581,10 +593,12 @@ def get(
     - ``on_missing``: what a missing value — recv deadline expired,
       retries exhausted, injected fault — turns into. ``"raise"``
       (default) propagates the failure; ``"drop"`` removes missing
-      entries from a list result; ``"default"`` substitutes ``default``
-      (``fed.MISSING`` if left at None). A ``FedRemoteError`` envelope
-      always re-raises regardless: the peer was alive and its task
-      *failed*, which no aggregation should silently average over.
+      entries from a list result (a single missing FedObject resolves
+      to ``fed.MISSING``, there being no list to drop it from);
+      ``"default"`` substitutes ``default`` (``fed.MISSING`` if left at
+      None). A ``FedRemoteError`` envelope always re-raises regardless:
+      the peer was alive and its task *failed*, which no aggregation
+      should silently average over.
     - ``default``: the substitute under ``on_missing="default"``. None
       means the :data:`rayfed_tpu.MISSING` sentinel, which
       ``ops.aggregate.elastic_weighted_mean`` skips natively.
@@ -600,12 +614,6 @@ def get(
     )
 
     validate_on_missing(on_missing)
-    if isinstance(fed_objects, FedObject) and on_missing == "drop":
-        raise ValueError(
-            "on_missing='drop' needs a list of FedObjects (there is "
-            "nothing to drop a single result into); use "
-            "on_missing='default' for a single object"
-        )
     if default is None:
         default = MISSING
     # get() is itself a node in the DAG: it burns one seq id so every
@@ -662,7 +670,12 @@ def get(
             if on_missing == "drop":
                 gone = set(missing)
                 values = [v for i, v in enumerate(values) if i not in gone]
-        return values[0] if single else values
+        if single:
+            # A dropped single object leaves nothing to index: it
+            # resolves to the MISSING sentinel instead (the ergonomic
+            # twin of on_missing="default" with the default default).
+            return values[0] if values else MISSING
+        return values
     except FedRemoteError as e:
         logger.warning(
             "A peer party's task failed; re-raising its error envelope: %s",

@@ -1,0 +1,589 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Asynchronous buffered aggregation (FedBuff-style) + round pipelining.
+
+Synchronous ``fed_aggregate`` is lock-step: a round completes only when
+every party arrives, so one straggler stalls the job. This module adds
+the buffered alternative (Nguyen et al. 2022, "Federated Learning with
+Buffered Asynchronous Aggregation"): contributions fold into a buffer on
+the receiving party *as they arrive*, each weighted by how stale its
+base round is, and a new global model is published every K accepted
+contributions. Stragglers cost themselves staleness decay instead of
+costing the job wall-clock.
+
+Division of labor:
+
+- :class:`BufferedAggregator` — the pure, transport-free server state
+  (buffer, staleness weighting, K-publish, liveness filtering). Unit
+  tests drive it directly; determinism is its contract: a fixed arrival
+  order folds through the same topology plans ``fed_aggregate`` lowers
+  to (``ops.aggregate.reduce_by_plan`` stepwise, ``psum_by_plan`` when
+  the buffered parties compose onto one registered mesh), so replaying
+  the same arrivals reproduces the aggregate bitwise.
+- ``_async_offer`` / ``_async_current`` — ordinary ``@fed.remote`` POOL
+  tasks executing at the aggregating (root) party. Deliberately not an
+  actor: actor lanes resolve arguments inside one serial thread, so a
+  straggler's in-flight push would head-of-line-block every later offer
+  and degenerate async back to sync. Pool tasks each park on their own
+  worker while their contribution is in flight.
+- :func:`async_round` / ``fed_aggregate(mode="async")`` — the driver
+  surface. Every driver lays out the identical calls (multi-controller
+  contract); each party's contribution owner-pushes to the root, and
+  the returned handle is non-blocking so round t+1 compute starts while
+  the round-t push is still on the wire. The aggregator SNAPSHOTS each
+  contribution's mutable leaves when the offer lands (a buffered tree
+  may sit un-folded across several rounds — without the copy, a driver
+  reusing its gradient buffer in place would poison the pending fold).
+
+Staleness is measured in *round tags*: the driver stamps every
+contribution with its round index (auto-incremented per session when not
+given), and a contribution's staleness is how many tags the aggregator
+has seen beyond it at fold time. Tags ride the offer task's arguments —
+identical on every driver, so no party's local clock leaks into the
+fold. DEAD parties (the root's ``fed.liveness_view()``) are dropped from
+the buffer; SUSPECT ones are down-weighted by ``async_suspect_factor``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from rayfed_tpu import api as fed
+from rayfed_tpu import tracing
+from rayfed_tpu.config import AsyncAggregationConfig
+from rayfed_tpu.fed_object import FedObject
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Staleness decay
+# ---------------------------------------------------------------------------
+
+#: Named staleness-decay families (``aggregation.async_staleness``).
+STALENESS_FNS = ("poly", "constant", "exp")
+
+
+def resolve_staleness_fn(
+    spec: Any, exp: float = 0.5
+) -> Callable[[int], float]:
+    """Resolve a staleness spec to ``f(s) -> weight multiplier``.
+
+    ``"poly"`` (FedBuff's default): ``(1 + s) ** -exp`` — gentle decay,
+    a one-round-stale update still carries most of its weight.
+    ``"constant"``: 1.0 regardless of staleness (pure FedAsync buffer).
+    ``"exp"``: ``exp ** s`` for ``0 < exp <= 1`` — aggressive decay.
+    A callable passes through unchanged (local/unit-test use only: a
+    callable cannot ride the wire to the aggregating party).
+    """
+    if callable(spec):
+        return spec
+    if spec == "poly":
+        return lambda s: (1.0 + float(s)) ** -float(exp)
+    if spec == "constant":
+        return lambda s: 1.0
+    if spec == "exp":
+        if not (0.0 < float(exp) <= 1.0):
+            raise ValueError(
+                f"staleness='exp' needs 0 < async_staleness_exp <= 1 "
+                f"(the per-round multiplier), got {exp}"
+            )
+        return lambda s: float(exp) ** float(s)
+    raise ValueError(
+        f"unknown staleness fn {spec!r}; expected one of {STALENESS_FNS} "
+        f"or a callable"
+    )
+
+
+# ---------------------------------------------------------------------------
+# The buffered aggregator (pure server-side state)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Contribution:
+    slot: str          # unique buffer label ("party#arrival_idx")
+    party: str
+    round_tag: int
+    staleness: int
+    tree: Any
+    weight: float      # base * staleness decay * liveness factor
+
+
+def _snapshot_tree(tree: Any) -> Any:
+    """Copy mutable (numpy) leaves so the buffered contribution is
+    immune to the offering driver reusing its buffer in place while the
+    fold is still pending. jax arrays are immutable; everything else
+    small (scalars, lists) is left alone — the buffer never hands leaves
+    back out for mutation."""
+    import numpy as np
+
+    from rayfed_tpu import tree_util
+
+    def leaf(x):
+        return x.copy() if isinstance(x, np.ndarray) else x
+
+    return tree_util.tree_map(leaf, tree)
+
+
+class BufferedAggregator:
+    """FedBuff server state for one async session.
+
+    ``offer()`` is the only mutating entry point: it applies the
+    liveness verdict (DEAD drops, SUSPECT down-weights), stamps the
+    contribution's staleness against the newest round tag seen, and —
+    every ``buffer_k`` accepted contributions — folds the buffer into a
+    staleness-weighted mean, mixes it into the current global model at
+    ``server_lr``, bumps the version, and fires ``publish_cb``.
+
+    Determinism contract (asserted in tests/test_async_rounds.py): the
+    fold consumes the buffer in arrival order through
+    ``ops.aggregate.reduce_by_plan`` over a flat plan whose slots are
+    the arrival sequence — the same premultiply/fold/scale association
+    ``fed_aggregate`` produces on the wire — so a fixed arrival order
+    yields a bitwise-identical aggregate on every replay. When the
+    buffered parties are distinct and compose onto the registered party
+    mesh (``mesh.compose_party_mesh``), the fold lowers to
+    ``psum_by_plan`` in registered-mesh order instead: one collective,
+    same bit contract for a fixed arrival *set*.
+    """
+
+    def __init__(
+        self,
+        cfg: AsyncAggregationConfig,
+        *,
+        liveness_fn: Optional[Callable[[], Dict[str, str]]] = None,
+        publish_cb: Optional[Callable[[int, Any], None]] = None,
+        staleness_fn: Optional[Callable[[int], float]] = None,
+        session: str = "default",
+    ):
+        self.cfg = cfg
+        self.session = session
+        self._staleness_fn = staleness_fn or resolve_staleness_fn(
+            cfg.staleness, cfg.staleness_exp
+        )
+        self._liveness_fn = liveness_fn
+        self._publish_cb = publish_cb
+        self._lock = threading.Lock()
+        self._buffer: List[_Contribution] = []
+        self._arrivals = 0
+        self._latest_tag = -1
+        self._current: Any = None
+        self.version = 0
+        self.stats: Dict[str, int] = {
+            "accepted": 0,
+            "dropped_dead": 0,
+            "dropped_stale": 0,
+            "publishes": 0,
+            "publish_errors": 0,
+        }
+
+    # -- queries ------------------------------------------------------------
+
+    def current(self) -> Dict[str, Any]:
+        """The newest published global model: ``{"version", "params"}``
+        (version 0 / params None before the first K-publish)."""
+        with self._lock:
+            return {"version": self.version, "params": self._current}
+
+    def snapshot_stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self.stats)
+            out["version"] = self.version
+            out["buffered"] = len(self._buffer)
+            out["latest_round_tag"] = self._latest_tag
+            return out
+
+    # -- the one mutating entry point ---------------------------------------
+
+    def offer(
+        self, party: str, tree: Any, *, round_tag: int, weight: float = 1.0
+    ) -> Dict[str, Any]:
+        """Fold one contribution into the buffer; publish on the Kth.
+
+        Returns a small status dict (msgpack-clean scalars only — it
+        rides the inline small-message lane back to the offering party):
+        ``accepted``, ``reason`` (when not), ``staleness``, ``weight``
+        (the effective post-decay weight), ``buffered``, ``version``.
+        """
+        from rayfed_tpu.resilience.liveness import DEAD, state_weight
+
+        t0 = time.perf_counter()
+        view = self._liveness_fn() if self._liveness_fn else {}
+        state = view.get(party)
+        tree = _snapshot_tree(tree)
+        with self._lock:
+            self._latest_tag = max(self._latest_tag, int(round_tag))
+            staleness = self._latest_tag - int(round_tag)
+            if state == DEAD:
+                self.stats["dropped_dead"] += 1
+                return {
+                    "accepted": False, "reason": "dead",
+                    "staleness": staleness, "weight": 0.0,
+                    "buffered": len(self._buffer), "version": self.version,
+                }
+            if (
+                self.cfg.max_staleness is not None
+                and staleness > self.cfg.max_staleness
+            ):
+                self.stats["dropped_stale"] += 1
+                return {
+                    "accepted": False, "reason": "stale",
+                    "staleness": staleness, "weight": 0.0,
+                    "buffered": len(self._buffer), "version": self.version,
+                }
+            eff = (
+                float(weight)
+                * float(self._staleness_fn(staleness))
+                * state_weight(state, self.cfg.suspect_factor)
+            )
+            slot = f"{party}#{self._arrivals}"
+            self._arrivals += 1
+            self._buffer.append(
+                _Contribution(slot, party, int(round_tag), staleness,
+                              tree, eff)
+            )
+            self.stats["accepted"] += 1
+            published = None
+            if len(self._buffer) >= self.cfg.buffer_k:
+                published = self._fold_and_publish_locked(t0)
+            return {
+                "accepted": True, "staleness": staleness, "weight": eff,
+                "buffered": len(self._buffer), "version": self.version,
+                **({"published": published} if published else {}),
+            }
+
+    # -- internals ----------------------------------------------------------
+
+    def _fold_and_publish_locked(self, t0: float) -> int:
+        from rayfed_tpu.ops.aggregate import (
+            psum_by_plan,
+            reduce_by_plan,
+            tree_mix,
+        )
+
+        buf, self._buffer = self._buffer, []
+        parties = [c.party for c in buf]
+        plan = self._plan_for(parties)
+        if plan is not None:
+            # Same-mesh fast path: one collective over the composed
+            # party mesh, folding in registered-mesh order.
+            by_party = {c.party: c for c in buf}
+            mean = psum_by_plan(
+                plan,
+                {p: by_party[p].tree for p in plan.parties},
+                weights={p: by_party[p].weight for p in plan.parties},
+            )
+            path = "psum"
+        else:
+            from rayfed_tpu import topology as topo
+
+            slot_plan = topo.plan_buffer([c.slot for c in buf])
+            mean = reduce_by_plan(
+                slot_plan,
+                {c.slot: c.tree for c in buf},
+                weights={c.slot: c.weight for c in buf},
+            )
+            path = "fold"
+        self._current = tree_mix(self._current, mean, self.cfg.server_lr)
+        self.version += 1
+        self.stats["publishes"] += 1
+        tracing.record(
+            "fold", "", f"async:{self.session}", f"v{self.version}",
+            0, t0,
+            path=path, k=len(buf),
+            round_tags=[c.round_tag for c in buf],
+        )
+        if self._publish_cb is not None:
+            tp = time.perf_counter()
+            try:
+                self._publish_cb(self.version, self._current)
+                tracing.record(
+                    "publish", "", f"async:{self.session}",
+                    f"v{self.version}", 0, tp,
+                )
+            except Exception as e:  # noqa: BLE001 - a failed downstream
+                # publish must not poison the aggregation itself
+                self.stats["publish_errors"] += 1
+                tracing.record(
+                    "publish", "", f"async:{self.session}",
+                    f"v{self.version}", 0, tp, ok=False,
+                )
+                logger.warning(
+                    "async session %r publish hook failed at v%d: %r",
+                    self.session, self.version, e,
+                )
+        return self.version
+
+    def _plan_for(self, parties: List[str]):
+        """A flat plan in registered-mesh order when the buffered parties
+        are distinct and exactly the composed party mesh; else None (the
+        arrival-order reduce_by_plan path)."""
+        import sys as _sys
+
+        mesh_mod = _sys.modules.get("rayfed_tpu.mesh")
+        if mesh_mod is None:
+            return None  # no mesh was ever composed in this process
+        registered = mesh_mod.get_composed_parties()
+        if registered is None:
+            return None
+        if len(set(parties)) != len(parties):
+            return None  # duplicate contributor: slots are not parties
+        if set(parties) != set(registered):
+            return None
+        from rayfed_tpu import topology as topo
+
+        plan = topo.plan(list(registered), "flat")
+        if mesh_mod.composed_mesh_for(plan.parties) is None:
+            return None
+        return plan
+
+
+# ---------------------------------------------------------------------------
+# Process-local session registry (lives at the aggregating party)
+# ---------------------------------------------------------------------------
+
+_sessions: Dict[str, BufferedAggregator] = {}
+_sessions_lock = threading.Lock()
+
+
+def _serve_publish_cb(serve_name: str) -> Callable[[int, Any], None]:
+    def cb(version: int, params: Any) -> None:
+        from rayfed_tpu.serving.server import get_server
+
+        get_server(serve_name).publish(params)
+
+    return cb
+
+
+def _get_or_create_session(
+    name: str, cfg_dict: Dict[str, Any], serve_name: Optional[str]
+) -> BufferedAggregator:
+    with _sessions_lock:
+        agg = _sessions.get(name)
+        if agg is None:
+            from rayfed_tpu.resilience.liveness import liveness_view
+
+            agg = BufferedAggregator(
+                AsyncAggregationConfig(**cfg_dict),
+                liveness_fn=liveness_view,
+                publish_cb=(
+                    _serve_publish_cb(serve_name) if serve_name else None
+                ),
+                session=name,
+            )
+            _sessions[name] = agg
+        return agg
+
+
+def get_session(name: str = "default") -> Optional[BufferedAggregator]:
+    """The named session's aggregator in THIS process (None when this
+    process is not the aggregating party, or nothing arrived yet)."""
+    with _sessions_lock:
+        return _sessions.get(name)
+
+
+def reset_sessions() -> None:
+    """Drop all aggregator state and driver-side round counters (called
+    by ``fed.shutdown`` — a new job must not fold into an old buffer)."""
+    with _sessions_lock:
+        _sessions.clear()
+    with _tags_lock:
+        _driver_round_tags.clear()
+
+
+# ---------------------------------------------------------------------------
+# Remote surface (pool tasks at the root — see module docstring for why
+# these are deliberately not an actor)
+# ---------------------------------------------------------------------------
+
+
+@fed.remote
+def _async_offer(name, cfg_dict, serve_name, party, round_tag, weight, tree):
+    agg = _get_or_create_session(name, cfg_dict, serve_name)
+    return agg.offer(party, tree, round_tag=round_tag, weight=weight)
+
+
+@fed.remote
+def _async_current(name, cfg_dict, serve_name):
+    agg = _get_or_create_session(name, cfg_dict, serve_name)
+    return agg.current()
+
+
+@fed.remote
+def _async_stats(name, cfg_dict, serve_name):
+    agg = _get_or_create_session(name, cfg_dict, serve_name)
+    return agg.snapshot_stats()
+
+
+# ---------------------------------------------------------------------------
+# Driver surface
+# ---------------------------------------------------------------------------
+
+# Job default (config['aggregation']['async_*'] from fed.init), following
+# the topology.set_default pattern: every driver reads the same config,
+# so every driver ships the identical cfg to the root.
+_default_cfg_lock = threading.Lock()
+_default_cfg: Optional[AsyncAggregationConfig] = None
+
+# Driver-side auto round tags, per session name. Every driver runs the
+# same program, so the counters advance identically on all parties.
+_tags_lock = threading.Lock()
+_driver_round_tags: Dict[str, int] = {}
+
+
+def set_default_async_config(aggregation_dict: Dict[str, Any]) -> None:
+    """Validate and install the ``aggregation.async_*`` job defaults
+    (called by ``fed.init``; raises on unknown keys or bad values so a
+    typo'd config rejects init, not the first round)."""
+    global _default_cfg
+    cfg = AsyncAggregationConfig.from_aggregation_dict(aggregation_dict)
+    resolve_staleness_fn(cfg.staleness, cfg.staleness_exp)  # validate combo
+    with _default_cfg_lock:
+        _default_cfg = cfg
+
+
+def get_default_async_config() -> AsyncAggregationConfig:
+    with _default_cfg_lock:
+        return _default_cfg or AsyncAggregationConfig()
+
+
+def reset_default_async_config() -> None:
+    global _default_cfg
+    with _default_cfg_lock:
+        _default_cfg = None
+
+
+def _next_round_tag(session: str) -> int:
+    with _tags_lock:
+        tag = _driver_round_tags.get(session, 0)
+        _driver_round_tags[session] = tag + 1
+        return tag
+
+
+@dataclass
+class AsyncRoundHandle:
+    """Non-blocking view of one async round: the per-party offer-status
+    FedObjects and the newest global model at the root.
+
+    Nothing here blocks — pull ``model`` with ``fed.get(handle.model,
+    timeout=..., on_missing=...)`` for a bounded wait; ``params`` may be
+    None (version 0) until the buffer first fills to K."""
+
+    round_tag: int
+    root: str
+    session: str
+    offers: Dict[str, FedObject] = field(default_factory=dict)
+    model: Optional[FedObject] = None
+
+
+def async_round(
+    objs: Dict[str, Any],
+    *,
+    round_tag: Optional[int] = None,
+    root: Optional[str] = None,
+    weights: Optional[Dict[str, float]] = None,
+    buffer_k: Optional[int] = None,
+    staleness_fn: Optional[str] = None,
+    server_lr: Optional[float] = None,
+    session: str = "default",
+    publish_to: Any = None,
+    fetch_model: bool = True,
+) -> AsyncRoundHandle:
+    """Offer ``{party: FedObject-of-pytree}`` into the session's buffer
+    at the root and return without waiting for anything.
+
+    Every driver must make the identical call (multi-controller
+    contract — offers and the model fetch burn seq ids). Each party's
+    contribution owner-pushes to ``root`` when that party's driver
+    reaches this call; the aggregator folds arrivals as they land and
+    publishes every ``buffer_k``. The returned handle's ``model`` is the
+    root's newest published global model *at the time the root executes
+    the fetch* — it may or may not include this round's contributions;
+    that is the async contract (docs/async_rounds.md).
+
+    ``round_tag`` stamps the contributions' staleness bucket; when None,
+    a per-``session`` driver-side counter auto-increments (identically
+    on every driver). ``buffer_k`` / ``staleness_fn`` (a name from
+    :data:`STALENESS_FNS`) / ``server_lr`` override the
+    ``aggregation.async_*`` job defaults. ``publish_to`` (a
+    ``ServeHandle`` hosted at the root party) hot-publishes each
+    K-publish into the serving plane in-process. ``fetch_model=False``
+    skips the model fetch (pipelined inner rounds that only push).
+    """
+    assert objs, "need at least one party's contribution"
+    if root is None:
+        root = next(iter(objs))
+    cfg = get_default_async_config()
+    overrides: Dict[str, Any] = {}
+    if buffer_k is not None:
+        overrides["buffer_k"] = int(buffer_k)
+    if staleness_fn is not None:
+        if callable(staleness_fn):
+            raise TypeError(
+                "async staleness_fn must be a name from STALENESS_FNS "
+                "here (a callable cannot ride the wire to the root); "
+                "pass callables to BufferedAggregator directly"
+            )
+        overrides["staleness"] = staleness_fn
+    if server_lr is not None:
+        overrides["server_lr"] = float(server_lr)
+    if overrides:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, **overrides)
+    cfg_dict = cfg.as_dict()
+    resolve_staleness_fn(cfg.staleness, cfg.staleness_exp)
+
+    serve_name = None
+    if publish_to is not None:
+        if publish_to.party != root:
+            raise ValueError(
+                f"publish_to must be hosted at the aggregating root "
+                f"(serving party {publish_to.party!r} != root {root!r}): "
+                f"the K-publish hook installs versions in-process"
+            )
+        serve_name = publish_to.name
+    if round_tag is None:
+        round_tag = _next_round_tag(session)
+
+    handle = AsyncRoundHandle(
+        round_tag=int(round_tag), root=root, session=session
+    )
+    for party in objs:
+        w = 1.0 if weights is None else float(weights[party])
+        handle.offers[party] = _async_offer.party(root).remote(
+            session, cfg_dict, serve_name, party, int(round_tag), w,
+            objs[party],
+        )
+    if fetch_model:
+        handle.model = _async_current.party(root).remote(
+            session, cfg_dict, serve_name
+        )
+    return handle
+
+
+def async_session_stats(
+    root: str, session: str = "default"
+) -> FedObject:
+    """FedObject of the session's counters at the root (accepted /
+    dropped_dead / dropped_stale / publishes / version / buffered)."""
+    return _async_stats.party(root).remote(
+        session, get_default_async_config().as_dict(), None
+    )

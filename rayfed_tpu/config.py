@@ -352,6 +352,93 @@ class PartyMeshConfig:
 
 
 @dataclasses.dataclass
+class AsyncAggregationConfig:
+    """Buffered-async aggregation knobs (``config['aggregation']`` keys
+    prefixed ``async_``, validated at ``fed.init``; docs/async_rounds.md).
+
+    Attributes:
+        buffer_k: accepted contributions per K-publish — FedBuff's buffer
+            size. 1 degenerates to pure FedAsync (publish every arrival).
+        staleness: decay family applied to a contribution ``s`` rounds
+            stale: "poly" ``(1+s)**-exp`` (FedBuff's default), "constant"
+            (no decay), or "exp" ``exp**s``.
+        staleness_exp: the decay family's parameter.
+        server_lr: server learning rate mixing each K-publish into the
+            running global model, ``new = old + lr * (mean - old)``.
+            1.0 (default) replaces the model with the buffered mean
+            exactly (bitwise — no mix arithmetic runs).
+        suspect_factor: multiplicative down-weight for contributions from
+            SUSPECT parties (liveness view); DEAD parties are dropped
+            outright regardless.
+        max_staleness: drop contributions more than this many rounds
+            stale (None = keep all, decay-weighted).
+    """
+
+    buffer_k: int = 2
+    staleness: str = "poly"
+    staleness_exp: float = 0.5
+    server_lr: float = 1.0
+    suspect_factor: float = 1.0
+    max_staleness: Optional[int] = None
+
+    def __post_init__(self):
+        if int(self.buffer_k) < 1:
+            raise ValueError(
+                f"aggregation.async_buffer_k must be >= 1, "
+                f"got {self.buffer_k}"
+            )
+        self.buffer_k = int(self.buffer_k)
+        if self.staleness not in ("poly", "constant", "exp"):
+            raise ValueError(
+                "aggregation.async_staleness must be 'poly', 'constant' "
+                f"or 'exp', got {self.staleness!r}"
+            )
+        if not (0.0 < float(self.server_lr) <= 1.0):
+            raise ValueError(
+                f"aggregation.async_server_lr must be in (0, 1], "
+                f"got {self.server_lr}"
+            )
+        if not (0.0 <= float(self.suspect_factor) <= 1.0):
+            raise ValueError(
+                f"aggregation.async_suspect_factor must be in [0, 1], "
+                f"got {self.suspect_factor}"
+            )
+        if self.max_staleness is not None and int(self.max_staleness) < 0:
+            raise ValueError(
+                f"aggregation.async_max_staleness must be >= 0 or None, "
+                f"got {self.max_staleness}"
+            )
+
+    _KEY_PREFIX = "async_"
+
+    @classmethod
+    def from_aggregation_dict(
+        cls, data: Optional[Dict[str, Any]]
+    ) -> "AsyncAggregationConfig":
+        """Build from the ``aggregation`` config section's ``async_*``
+        keys. Unknown ``async_*`` keys raise (the sync keys — topology,
+        group_size — are validated by ``topology.set_default``)."""
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for key, value in data.items():
+            if not key.startswith(cls._KEY_PREFIX):
+                continue
+            name = key[len(cls._KEY_PREFIX):]
+            if name not in field_names:
+                known = sorted(cls._KEY_PREFIX + f for f in field_names)
+                raise ValueError(
+                    f"unknown aggregation config key {key!r}; "
+                    f"known async keys: {known}"
+                )
+            kwargs[name] = value
+        return cls(**kwargs)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class ServingConfig:
     """Inference serving plane knobs (``config['serving']``, docs/serving.md).
 

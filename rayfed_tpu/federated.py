@@ -127,6 +127,10 @@ def fed_aggregate(
     liveness: Optional[Dict[str, str]] = None,
     plan: Optional[topo.TopologyPlan] = None,
     publish_to: Any = None,
+    mode: str = "sync",
+    buffer_k: Optional[int] = None,
+    staleness_fn: Optional[str] = None,
+    round_tag: Optional[int] = None,
 ) -> Any:
     """Reduce ``{party: FedObject-of-pytree}`` along a planned topology.
 
@@ -137,6 +141,18 @@ def fed_aggregate(
     every driver lays out the identical DAG).
 
     op: "sum", "mean", or "wmean" (sample-count weighting via ``weights``).
+    mode: "sync" (default — the lock-step reduction below) or "async"
+        (FedBuff-style buffered aggregation, docs/async_rounds.md): each
+        contribution is OFFERED to a buffered aggregator at the root and
+        the call returns an :class:`~rayfed_tpu.async_rounds.AsyncRoundHandle`
+        immediately — ``handle.model`` is a FedObject of the newest
+        published ``{"version", "params"}`` at the root, which may not
+        yet include this round's contributions. ``buffer_k`` (publish
+        every K accepted contributions), ``staleness_fn`` ("poly" |
+        "constant" | "exp") and ``round_tag`` (staleness bucket; auto-
+        incremented when None) apply only to async mode, which supports
+        op "mean"/"wmean"; ``topology``/``plan`` are sync-only (the
+        async fold orders itself by arrival).
     topology: "auto" | "flat" | "tree" | "ring" | "hier"; None reads the
         job default set by ``config['aggregation']['topology']``.
     liveness: a ``fed.liveness_view()``-shaped ``{party: state}`` dict;
@@ -155,6 +171,36 @@ def fed_aggregate(
         for the next round.
     """
     assert objs, "need at least one party's object"
+    if mode == "async":
+        if op not in ("mean", "wmean"):
+            raise ValueError(
+                f"mode='async' aggregates a staleness-weighted mean; "
+                f"op={op!r} is sync-only"
+            )
+        if plan is not None or topology is not None:
+            raise ValueError(
+                "mode='async' folds in arrival order — topology=/plan= "
+                "are sync-only knobs"
+            )
+        if op == "wmean" and weights is None:
+            raise ValueError("op='wmean' needs weights={party: w}")
+        from rayfed_tpu import async_rounds
+
+        return async_rounds.async_round(
+            objs,
+            round_tag=round_tag,
+            weights=weights if op == "wmean" else None,
+            buffer_k=buffer_k,
+            staleness_fn=staleness_fn,
+            publish_to=publish_to,
+        )
+    if mode != "sync":
+        raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
+    if buffer_k is not None or staleness_fn is not None or round_tag is not None:
+        raise ValueError(
+            "buffer_k/staleness_fn/round_tag are async-only knobs; "
+            "pass mode='async'"
+        )
     if plan is None:
         default_topo, group_size = topo.get_default()
         dead = set()

@@ -96,6 +96,32 @@ def tree_weighted_mean(trees, weights, acc_dtype: Optional[str] = "float32"):
     return _tree_weighted_mean(tuple(trees), tuple(weights), acc_dtype=acc_dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("acc_dtype",))
+def _tree_mix(old, new, lr, acc_dtype: Optional[str] = "float32"):
+    dtype = jnp.dtype(acc_dtype) if acc_dtype else None
+
+    def leaf(o, n):
+        oa = o.astype(dtype) if dtype is not None else o
+        na = n.astype(dtype) if dtype is not None else n
+        return (oa + lr * (na - oa)).astype(n.dtype)
+
+    return jax.tree_util.tree_map(leaf, old, new)
+
+
+def tree_mix(old, new, lr: float, acc_dtype: Optional[str] = "float32"):
+    """Server-learning-rate mix for buffered-async rounds (FedBuff's
+    server step): ``old + lr * (new - old)`` per leaf, accumulated in
+    ``acc_dtype`` and cast back to the leaf dtype.
+
+    ``lr == 1.0`` or ``old is None`` returns ``new`` UNTOUCHED — the
+    async determinism contract requires the default configuration's
+    published model to be bitwise the buffered mean, with no mix
+    arithmetic perturbing it."""
+    if old is None or lr == 1.0:
+        return new
+    return _tree_mix(old, new, float(lr), acc_dtype=acc_dtype)
+
+
 def reduce_by_plan(
     plan,
     contributions,

@@ -259,3 +259,17 @@ def party_state(party: str) -> str:
     evidence of death = optimistic default, matching the engine's
     behavior before this subsystem existed)."""
     return ALIVE if _monitor is None else _monitor.state(party)
+
+
+def state_weight(state: Optional[str], suspect_factor: float = 1.0) -> float:
+    """Multiplicative aggregation weight for a liveness verdict: ALIVE
+    (or no verdict) 1.0, SUSPECT ``suspect_factor``, DEAD 0.0. The async
+    buffered aggregator applies this on every offer — a SUSPECT party's
+    contribution is down-weighted rather than dropped (its heartbeats
+    may just be delayed with its data), while DEAD contributions carry
+    zero weight and are excluded from the buffer outright."""
+    if state == DEAD:
+        return 0.0
+    if state == SUSPECT:
+        return float(suspect_factor)
+    return 1.0

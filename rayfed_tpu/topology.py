@@ -287,6 +287,22 @@ def replan(old: TopologyPlan, dead: Iterable[str],
     )
 
 
+def plan_buffer(slots: Iterable[str]) -> TopologyPlan:
+    """A flat plan over buffered-arrival SLOT labels (async rounds,
+    docs/async_rounds.md): the async aggregator folds its buffer in
+    arrival order, labeling each contribution ``party#arrival_idx`` so a
+    party contributing twice in one buffer occupies two slots. The plan's
+    association order IS the arrival order — replaying the same arrivals
+    through ``ops.aggregate.reduce_by_plan`` reproduces the aggregate
+    bitwise (the async determinism contract)."""
+    slots = list(slots)
+    if not slots:
+        raise ValueError("plan_buffer needs at least one buffered slot")
+    if len(set(slots)) != len(slots):
+        raise ValueError(f"buffer slot labels must be unique, got {slots}")
+    return plan(slots, "flat")
+
+
 # ---------------------------------------------------------------------------
 # Job-level default (config: aggregation.topology / aggregation.group_size)
 # ---------------------------------------------------------------------------

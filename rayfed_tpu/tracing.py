@@ -87,7 +87,9 @@ def get_spans(kind: Optional[str] = None) -> List[Span]:
 
 # Kinds whose spans bracket the full operation (duration is meaningful);
 # "recv" spans are arrival events with no duration — no throughput for them.
-_TIMED_KINDS = {"send", "decode", "task"}
+# "fold"/"publish" are the async aggregation buffer's K-publish spans
+# (rayfed_tpu/async_rounds.py; docs/async_rounds.md).
+_TIMED_KINDS = {"send", "decode", "task", "fold", "publish"}
 
 
 def summary() -> Dict[str, Dict]:
@@ -186,10 +188,75 @@ def export_timeline(path: str, party: str = "") -> int:
     return n
 
 
+def export_seq_timeline(path: str, party: str = "") -> int:
+    """Write the per-seq-id timeline as machine-readable JSON — the
+    structured twin of :func:`export_timeline`'s text artifact, and the
+    input format of ``tools/trace_view.py``'s text flamegraph.
+
+    Shape::
+
+        {"party": ..., "t0_s": <earliest span start>,
+         "edges": [{"up": ..., "down": ..., "events": [
+             {"kind", "peer", "t_s", "dur_s", "nbytes", "ok", ...extra},
+             ...]},   # time-ordered within each edge
+          ...]}       # edges ordered by first event
+
+    Every send/recv/decode/task span plus the async aggregator's
+    fold/publish spans lands here keyed by its (upstream, downstream)
+    seq-id edge, so a straggling round is traceable from the driver's
+    offer through the wire to the fold that consumed it. Returns the
+    number of events written. Same snapshot discipline as
+    :func:`export_timeline` — safe from a watchdog signal handler
+    (non-blocking lock attempt; deque iteration without the lock at
+    worst loses the in-flight span)."""
+    import json
+
+    acquired = _lock.acquire(blocking=False)
+    try:
+        spans = list(_spans)
+    finally:
+        if acquired:
+            _lock.release()
+    edges: Dict[tuple, List[Span]] = {}
+    for s in spans:
+        edges.setdefault((s.upstream_seq_id, s.downstream_seq_id), []).append(s)
+    edge_list = []
+    n = 0
+    for (up, down), group in sorted(
+        edges.items(), key=lambda kv: min(s.start_s for s in kv[1])
+    ):
+        events = []
+        for s in sorted(group, key=lambda s: s.start_s):
+            events.append({
+                "kind": s.kind,
+                "peer": s.peer,
+                "t_s": s.start_s,
+                "dur_s": s.duration_s if s.kind in _TIMED_KINDS else 0.0,
+                "nbytes": s.nbytes,
+                "ok": s.ok,
+                **s.extra,
+            })
+            n += 1
+        edge_list.append({"up": up, "down": down, "events": events})
+    doc = {
+        "party": party or "?",
+        "t0_s": min((s.start_s for s in spans), default=0.0),
+        "edges": edge_list,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        # default=str: extras are caller-provided and must never be able
+        # to fail the artifact (it is written from watchdog handlers).
+        json.dump(doc, f, default=str)
+    return n
+
+
 def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
-           nbytes: int, start_s: float, ok: bool = True) -> None:
+           nbytes: int, start_s: float, ok: bool = True, **extra) -> None:
     """Directly append a span (for async paths where a context manager
-    cannot bracket the operation — e.g. pipelined sends resolved by ack)."""
+    cannot bracket the operation — e.g. pipelined sends resolved by ack).
+    Extra keywords land in the span's ``extra`` dict (and therefore in
+    every exporter's per-event args) — the async aggregator stamps fold
+    spans with the buffered round tags this way."""
     if not _enabled:
         return
     with _lock:
@@ -203,6 +270,7 @@ def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
                 start_s=start_s,
                 duration_s=time.perf_counter() - start_s,
                 ok=ok,
+                extra=extra,
             )
         )
 

@@ -107,6 +107,8 @@ _SLOW_TESTS = {
     "test_two_party_fedavg_logreg",
     "test_peer_crash_mid_stream_is_detected",
     "test_chaos_fedavg_two_party_deterministic",
+    "test_async_rounds_land_while_sync_stalls",
+    "test_pipelined_rounds_overlap_without_corruption",
     "test_exit_on_sending_failure_exits_nonzero",
     "test_train_step_with_flash_attn_and_chunked_loss",
     "test_fed_train_step_ring_flash",

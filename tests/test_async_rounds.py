@@ -1,0 +1,554 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Asynchronous buffered aggregation (docs/async_rounds.md).
+
+Fast half: the BufferedAggregator driven directly — staleness decay
+math, K-publish cadence, liveness filtering, the bitwise-determinism
+contract against the sync lowering, and the offer-time snapshot that
+makes pipelined buffer reuse safe. Slow half: spawned 2-party runs
+under a seeded delay schedule asserting async rounds keep landing while
+lock-step sync stalls, and that pipelined rounds overlap the straggler
+delay without cross-round corruption.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import rayfed_tpu as fed
+from rayfed_tpu import topology as topo
+from rayfed_tpu.async_rounds import (
+    BufferedAggregator,
+    async_round,
+    resolve_staleness_fn,
+)
+from rayfed_tpu.config import AsyncAggregationConfig
+from rayfed_tpu.ops.aggregate import reduce_by_plan, tree_mix
+from rayfed_tpu.resilience.liveness import DEAD, SUSPECT, state_weight
+from tests.utils import FAST_COMM_CONFIG, get_addresses, run_parties
+
+
+# ---------------------------------------------------------------------------
+# Staleness decay + config validation
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_fns():
+    poly = resolve_staleness_fn("poly", exp=0.5)
+    assert poly(0) == 1.0
+    np.testing.assert_allclose(poly(1), 2.0 ** -0.5)
+    np.testing.assert_allclose(poly(3), 0.5)
+    const = resolve_staleness_fn("constant")
+    assert const(0) == const(7) == 1.0
+    expf = resolve_staleness_fn("exp", exp=0.5)
+    np.testing.assert_allclose(expf(2), 0.25)
+    # Callables pass through (local/unit use only).
+    f = lambda s: 42.0  # noqa: E731
+    assert resolve_staleness_fn(f) is f
+    with pytest.raises(ValueError, match="0 < async_staleness_exp"):
+        resolve_staleness_fn("exp", exp=1.5)
+    with pytest.raises(ValueError, match="poly"):
+        resolve_staleness_fn("linear")
+
+
+def test_async_config_from_aggregation_dict():
+    cfg = AsyncAggregationConfig.from_aggregation_dict(
+        {"async_buffer_k": 4, "async_staleness": "exp",
+         "async_staleness_exp": 0.9, "topology": "tree"}  # non-async ignored
+    )
+    assert cfg.buffer_k == 4
+    assert cfg.staleness == "exp"
+    # Round-trips through the wire dict.
+    assert AsyncAggregationConfig(**cfg.as_dict()) == cfg
+    # A typo'd async_* key is an error, not a silent default.
+    with pytest.raises(ValueError, match="async_bufer_k"):
+        AsyncAggregationConfig.from_aggregation_dict({"async_bufer_k": 2})
+
+
+def test_async_config_validates_ranges():
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(buffer_k=0)
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(server_lr=0.0)
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(server_lr=1.5)
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(suspect_factor=-0.1)
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(max_staleness=-1)
+    with pytest.raises(ValueError):
+        AsyncAggregationConfig(staleness="linear")
+
+
+# ---------------------------------------------------------------------------
+# BufferedAggregator: K-publish, staleness math, liveness, determinism
+# ---------------------------------------------------------------------------
+
+
+def _tree(v, n=8):
+    return {"g": np.full((n,), float(v), np.float32)}
+
+
+def test_publishes_every_k_contributions():
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=2, staleness="constant")
+    )
+    st = agg.offer("alice", _tree(1.0), round_tag=0)
+    assert st["accepted"] and st["version"] == 0 and st["buffered"] == 1
+    assert agg.current()["params"] is None  # nothing published yet
+    st = agg.offer("bob", _tree(3.0), round_tag=0)
+    assert st["version"] == 1 and st["published"] == 1
+    cur = agg.current()
+    assert cur["version"] == 1
+    np.testing.assert_allclose(np.asarray(cur["params"]["g"]), 2.0)
+    # The buffer restarts; a lone next-round offer stays buffered.
+    st = agg.offer("alice", _tree(5.0), round_tag=1)
+    assert st["buffered"] == 1 and st["version"] == 1
+    s = agg.snapshot_stats()
+    assert s["accepted"] == 3 and s["publishes"] == 1
+    assert s["latest_round_tag"] == 1 and s["buffered"] == 1
+
+
+def test_staleness_weight_math_matches_fedbuff():
+    # poly decay, exp 0.5: a 1-round-stale contribution carries 2^-0.5.
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=2, staleness="poly",
+                               staleness_exp=0.5)
+    )
+    st = agg.offer("alice", _tree(5.0), round_tag=1)
+    assert st["staleness"] == 0 and st["weight"] == 1.0
+    st = agg.offer("bob", _tree(1.0), round_tag=0)
+    w = 2.0 ** -0.5
+    assert st["staleness"] == 1
+    np.testing.assert_allclose(st["weight"], w)
+    expect = (5.0 + w * 1.0) / (1.0 + w)
+    np.testing.assert_allclose(
+        np.asarray(agg.current()["params"]["g"]),
+        np.float32(expect), rtol=1e-6,
+    )
+
+
+def test_dead_dropped_suspect_downweighted():
+    view = {"bob": SUSPECT, "carol": DEAD}
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=2, staleness="constant",
+                               suspect_factor=0.5),
+        liveness_fn=lambda: view,
+    )
+    st = agg.offer("carol", _tree(100.0), round_tag=0)
+    assert not st["accepted"] and st["reason"] == "dead"
+    agg.offer("alice", _tree(2.0), round_tag=0)
+    st = agg.offer("bob", _tree(4.0), round_tag=0)
+    assert st["weight"] == state_weight(SUSPECT, 0.5) == 0.5
+    # (1*2 + 0.5*4) / 1.5 — carol's 100s never touched the fold.
+    np.testing.assert_allclose(
+        np.asarray(agg.current()["params"]["g"]), np.float32(8.0 / 3.0),
+        rtol=1e-6,
+    )
+    assert agg.snapshot_stats()["dropped_dead"] == 1
+
+
+def test_max_staleness_drops_ancient_contributions():
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=10, staleness="constant",
+                               max_staleness=1)
+    )
+    agg.offer("alice", _tree(1.0), round_tag=5)
+    st = agg.offer("bob", _tree(9.0), round_tag=3)  # 2 rounds stale
+    assert not st["accepted"] and st["reason"] == "stale"
+    assert agg.snapshot_stats()["dropped_stale"] == 1
+    assert agg.snapshot_stats()["buffered"] == 1
+
+
+def test_fixed_arrival_order_replays_bitwise():
+    rng = np.random.default_rng(7)
+    trees = [
+        {"w": rng.standard_normal((33, 17)).astype(np.float32),
+         "b": rng.standard_normal(7).astype(np.float32)}
+        for _ in range(6)
+    ]
+    arrivals = [  # duplicate contributors + mixed staleness on purpose
+        ("alice", 0), ("bob", 0), ("alice", 1), ("carol", 0),
+        ("bob", 2), ("carol", 1),
+    ]
+
+    def run():
+        agg = BufferedAggregator(
+            AsyncAggregationConfig(buffer_k=3, staleness="poly",
+                                   server_lr=0.5)
+        )
+        for (party, tag), t in zip(arrivals, trees):
+            agg.offer(party, t, round_tag=tag)
+        return agg.current()
+
+    a, b = run(), run()
+    assert a["version"] == b["version"] == 2
+    for la, lb in zip(a["params"].values(), b["params"].values()):
+        assert np.asarray(la).tobytes() == np.asarray(lb).tobytes()
+
+
+def test_arrival_order_fold_matches_reduce_by_plan():
+    # The fold IS the sync lowering over arrival-order slots: same
+    # premultiply/fold/scale association, bit for bit.
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=3, staleness="constant")
+    )
+    rng = np.random.default_rng(3)
+    trees = [
+        {"w": rng.standard_normal((9, 5)).astype(np.float32)}
+        for _ in range(3)
+    ]
+    for i, t in enumerate(trees):
+        agg.offer("alice" if i % 2 == 0 else "bob", t,
+                  round_tag=0, weight=float(i + 1))
+    slots = [f"{'alice' if i % 2 == 0 else 'bob'}#{i}" for i in range(3)]
+    ref = reduce_by_plan(
+        topo.plan_buffer(slots),
+        dict(zip(slots, trees)),
+        weights={s: float(i + 1) for i, s in enumerate(slots)},
+    )
+    got = agg.current()["params"]
+    assert np.asarray(got["w"]).tobytes() == np.asarray(ref["w"]).tobytes()
+
+
+def test_psum_path_bitwise_matches_fold_path():
+    # When the buffered parties compose onto the registered party mesh,
+    # the fold lowers to one psum collective — same bits as the
+    # arrival-order reduce for the same weights (registered order is the
+    # arrival order here, making the two directly comparable).
+    from rayfed_tpu import mesh as mesh_mod
+
+    parties = ["p0", "p1", "p2", "p3"]
+    rng = np.random.default_rng(11)
+    trees = {
+        p: {"w": rng.standard_normal((17, 3)).astype(np.float32)}
+        for p in parties
+    }
+
+    def run():
+        agg = BufferedAggregator(
+            AsyncAggregationConfig(buffer_k=4, staleness="constant")
+        )
+        for i, p in enumerate(parties):
+            agg.offer(p, trees[p], round_tag=0, weight=float(2 * i + 1))
+        return agg.current()["params"]
+
+    mesh_mod.clear_composed_mesh()
+    try:
+        plain = run()
+        mesh_mod.compose_party_mesh(parties)
+        fast = run()
+    finally:
+        mesh_mod.clear_composed_mesh()
+    assert np.asarray(fast["w"]).tobytes() == np.asarray(plain["w"]).tobytes()
+
+
+def test_offer_snapshots_mutable_leaves():
+    # The donation-race guard: a buffered contribution must be immune to
+    # the offering driver reusing its gradient buffer in place while the
+    # fold is still pending (round t+1 compute during round t's buffer
+    # residence).
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=2, staleness="constant")
+    )
+    mine = np.full((8,), 1.0, np.float32)
+    agg.offer("alice", {"g": mine}, round_tag=0)
+    mine += 1000.0  # round t+1 reuses the buffer
+    agg.offer("bob", _tree(3.0), round_tag=0)
+    np.testing.assert_allclose(
+        np.asarray(agg.current()["params"]["g"]), 2.0
+    )
+
+
+def test_publish_cb_failure_does_not_poison_aggregation():
+    calls = []
+
+    def cb(version, params):
+        calls.append(version)
+        if version == 1:
+            raise RuntimeError("downstream serving hiccup")
+
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=1, staleness="constant"),
+        publish_cb=cb,
+    )
+    st = agg.offer("alice", _tree(1.0), round_tag=0)
+    assert st["accepted"] and st["version"] == 1  # fold survived the cb
+    agg.offer("alice", _tree(3.0), round_tag=1)
+    s = agg.snapshot_stats()
+    assert s["publishes"] == 2 and s["publish_errors"] == 1
+    assert calls == [1, 2]
+
+
+def test_server_lr_mixes_into_previous_model():
+    agg = BufferedAggregator(
+        AsyncAggregationConfig(buffer_k=1, staleness="constant",
+                               server_lr=0.5)
+    )
+    agg.offer("alice", _tree(4.0), round_tag=0)
+    np.testing.assert_allclose(  # first publish: no old model to mix
+        np.asarray(agg.current()["params"]["g"]), 4.0
+    )
+    agg.offer("alice", _tree(8.0), round_tag=1)
+    np.testing.assert_allclose(  # 4 + 0.5 * (8 - 4)
+        np.asarray(agg.current()["params"]["g"]), 6.0
+    )
+
+
+def test_tree_mix_identities_and_math():
+    new = {"g": np.full((4,), 8.0, np.float32)}
+    assert tree_mix(None, new, 0.5) is new
+    old = {"g": np.full((4,), 4.0, np.float32)}
+    assert tree_mix(old, new, 1.0) is new
+    out = tree_mix(old, new, 0.25)
+    np.testing.assert_allclose(np.asarray(out["g"]), 5.0)
+    assert np.asarray(out["g"]).dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# Driver surface validation (no runtime needed)
+# ---------------------------------------------------------------------------
+
+
+def test_async_round_rejects_callable_staleness():
+    with pytest.raises(TypeError, match="cannot ride the wire"):
+        async_round({"alice": object()}, staleness_fn=lambda s: 1.0)
+
+
+def test_async_round_requires_publish_target_at_root():
+    import types
+
+    handle = types.SimpleNamespace(party="bob", name="m")
+    with pytest.raises(ValueError, match="aggregating root"):
+        async_round({"alice": object()}, publish_to=handle)
+
+
+def test_fed_aggregate_mode_knob_validation():
+    from rayfed_tpu.federated import fed_aggregate
+
+    objs = {"alice": object()}
+    with pytest.raises(ValueError, match="sync-only"):
+        fed_aggregate(objs, op="sum", mode="async")
+    with pytest.raises(ValueError, match="sync-only"):
+        fed_aggregate(objs, mode="async", topology="tree")
+    with pytest.raises(ValueError, match="weights"):
+        fed_aggregate(objs, mode="async", op="wmean")
+    with pytest.raises(ValueError, match="mode must be"):
+        fed_aggregate(objs, mode="eventually")
+    with pytest.raises(ValueError, match="async-only"):
+        fed_aggregate(objs, buffer_k=2)
+    with pytest.raises(ValueError, match="async-only"):
+        fed_aggregate(objs, staleness_fn="poly")
+    with pytest.raises(ValueError, match="async-only"):
+        fed_aggregate(objs, round_tag=3)
+
+
+# ---------------------------------------------------------------------------
+# fed.get single + on_missing="drop" -> fed.MISSING (async ergonomics)
+# ---------------------------------------------------------------------------
+
+
+def test_get_single_missing_resolves_to_missing_sentinel():
+    addrs = get_addresses(["alice"])
+    fed.init(
+        addresses=addrs, party="alice", job_name="asyncdrop",
+        config={"cross_silo_comm": dict(FAST_COMM_CONFIG)},
+    )
+    try:
+
+        @fed.remote
+        class Slow:
+            def work(self, t):
+                time.sleep(t)
+                return 7
+
+        s = Slow.party("alice").remote()
+        pending = s.work.remote(1.5)  # parked on the actor lane
+        t0 = time.monotonic()
+        assert fed.get(pending, timeout=0.05, on_missing="drop") is fed.MISSING
+        assert time.monotonic() - t0 < 1.0  # returned at the timeout
+        # Once the value lands, the same policy returns it.
+        assert fed.get(pending, timeout=30.0, on_missing="drop") == 7
+    finally:
+        fed.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Spawned 2-party runs under a seeded straggler schedule (slow)
+# ---------------------------------------------------------------------------
+
+_DELAY_MS = 300
+_ROUNDS = 4
+
+
+def _straggler_config(seed):
+    return {
+        "cross_silo_comm": dict(FAST_COMM_CONFIG),
+        "resilience": {
+            "fault_schedule": {
+                "seed": seed,
+                "rules": [{
+                    "fault": "delay", "src": "bob", "prob": 1.0,
+                    "max_delay_ms": _DELAY_MS,
+                }],
+            },
+        },
+    }
+
+
+def _drain(handles):
+    # Every offer must resolve before fed.shutdown: a pending offer
+    # parks a pool worker at the root until the (delayed) contribution
+    # arrives, and an exiting straggler would strand it forever.
+    for h in handles:
+        fed.get(list(h.offers.values()))
+
+
+def _run_chaos_party(party, addresses):
+    import numpy as np_  # spawn target: keep imports self-contained
+
+    import rayfed_tpu as fed_
+    from rayfed_tpu.async_rounds import async_session_stats
+    from rayfed_tpu.federated import fed_aggregate
+
+    fed_.init(
+        addresses=addresses, party=party, config=_straggler_config(17),
+        job_name="async-chaos",
+    )
+
+    @fed_.remote
+    def contrib(base, r):
+        return {"g": np_.full((256,), float(base + r), np_.float32)}
+
+    bases = {"alice": 1.0, "bob": 2.0}
+
+    def objs(r):
+        return {p: contrib.party(p).remote(bases[p], r) for p in bases}
+
+    fed_.get(fed_aggregate(objs(0), op="mean"))  # warmup: dial + jit
+    # Lock-step window: every round waits out bob's injected delay.
+    t0 = time.monotonic()
+    for r in range(_ROUNDS):
+        val = fed_.get(fed_aggregate(objs(r), op="mean"))
+        np_.testing.assert_allclose(
+            np_.asarray(val["g"]), 1.5 + r, rtol=1e-6
+        )
+    t_sync = time.monotonic() - t0
+    # Async window: buffer_k=1 — alice's own offers publish without
+    # waiting for bob; bob's late pushes fold in as they land.
+    handles = []
+    t0 = time.monotonic()
+    for r in range(_ROUNDS):
+        handles.append(fed_.async_round(
+            objs(r), round_tag=r, buffer_k=1, staleness_fn="constant",
+            root="alice", session="chaos", fetch_model=False,
+        ))
+    deadline = time.monotonic() + 60
+    while True:
+        stats = fed_.get(async_session_stats("alice", "chaos"))
+        if stats["publishes"] >= _ROUNDS:
+            break
+        assert time.monotonic() < deadline, stats
+        time.sleep(0.02)
+    t_async = time.monotonic() - t0
+    _drain(handles)
+    # Async landed _ROUNDS publishes while sync was still paying the
+    # straggler tax every round.
+    assert t_async < t_sync, (t_async, t_sync)
+    assert t_sync > _ROUNDS * 0.02  # the injected delay actually bit
+    stats = fed_.get(async_session_stats("alice", "chaos"))
+    assert stats["accepted"] == 2 * _ROUNDS
+    assert stats["version"] == stats["publishes"] == 2 * _ROUNDS
+    fed_.shutdown()
+
+
+def test_async_rounds_land_while_sync_stalls():
+    run_parties(_run_chaos_party, ["alice", "bob"], timeout=180)
+
+
+def _run_pipelined_party(party, addresses):
+    import numpy as np_
+
+    import rayfed_tpu as fed_
+    from rayfed_tpu.async_rounds import async_session_stats
+
+    fed_.init(
+        addresses=addresses, party=party, config=_straggler_config(23),
+        job_name="async-pipe",
+    )
+
+    @fed_.remote
+    def contrib(base, r):
+        return {"g": np_.full((256,), float(base + r), np_.float32)}
+
+    bases = {"alice": 0.0, "bob": 1.0}
+
+    def objs(r):
+        return {p: contrib.party(p).remote(bases[p], r) for p in bases}
+
+    def window(session, pipelined):
+        handles = []
+        t0 = time.monotonic()
+        for r in range(_ROUNDS):
+            h = fed_.async_round(
+                objs(r), round_tag=r, buffer_k=2,
+                staleness_fn="constant", root="alice", session=session,
+                fetch_model=False,
+            )
+            handles.append(h)
+            if not pipelined:
+                _drain([h])  # wait out bob's delay before round r+1
+        deadline = time.monotonic() + 60
+        while True:
+            stats = fed_.get(async_session_stats("alice", session))
+            if stats["publishes"] >= _ROUNDS:
+                break
+            assert time.monotonic() < deadline, stats
+            time.sleep(0.02)
+        dt = time.monotonic() - t0
+        _drain(handles)
+        return dt
+
+    _drain([fed_.async_round(objs(0), round_tag=0, buffer_k=2,
+                             staleness_fn="constant", root="alice",
+                             session="warm", fetch_model=False)])
+    t_serial = window("serial", pipelined=False)
+    t_pipe = window("pipe", pipelined=True)
+    # Pipelined rounds overlap bob's delays (pay ~max, not ~sum) ...
+    assert t_pipe < t_serial, (t_pipe, t_serial)
+    # ... and the overlapping pushes never cross-contaminated a fold:
+    # every published model is a mean of legitimate contributions, so a
+    # final-model leaf outside [0, _ROUNDS] would be corruption.
+    m = fed_.get(fed_.async_round(
+        objs(_ROUNDS), round_tag=_ROUNDS, buffer_k=2,
+        staleness_fn="constant", root="alice", session="pipe",
+    ).model)
+    assert m["version"] >= _ROUNDS
+    leaves = np_.asarray(m["params"]["g"])
+    assert 0.0 <= leaves.min() and leaves.max() <= _ROUNDS + 1, leaves
+    # Drain the final round's offers too before shutdown.
+    stats = fed_.get(async_session_stats("alice", "pipe"))
+    assert stats["accepted"] >= 2 * _ROUNDS
+    deadline = time.monotonic() + 60
+    while fed_.get(async_session_stats("alice", "pipe"))["accepted"] < \
+            2 * (_ROUNDS + 1):
+        assert time.monotonic() < deadline
+        time.sleep(0.02)
+    fed_.shutdown()
+
+
+def test_pipelined_rounds_overlap_without_corruption():
+    run_parties(_run_pipelined_party, ["alice", "bob"], timeout=180)

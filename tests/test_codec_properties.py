@@ -24,11 +24,16 @@ process."""
 import msgpack
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
 
-from rayfed_tpu import tree_util
-from rayfed_tpu._private import serialization as ser
-from rayfed_tpu.proxy.tcp import wire
+pytest.importorskip(
+    "hypothesis",
+    reason="property fuzzing needs the hypothesis package (not installed)",
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from rayfed_tpu import tree_util  # noqa: E402
+from rayfed_tpu._private import serialization as ser  # noqa: E402
+from rayfed_tpu.proxy.tcp import wire  # noqa: E402
 
 DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_,
           np.float16]

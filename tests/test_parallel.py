@@ -21,12 +21,18 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from rayfed_tpu.models import transformer as tfm
-from rayfed_tpu.parallel import sharding as shd
-from rayfed_tpu.parallel.ring import ring_attention
-from rayfed_tpu.parallel.train import make_fed_train_step
+try:
+    from jax import shard_map
+except ImportError:
+    pytest.skip(
+        "requires jax >= 0.7 (top-level jax.shard_map API)",
+        allow_module_level=True,
+    )
 
-from jax import shard_map
+from rayfed_tpu.models import transformer as tfm  # noqa: E402
+from rayfed_tpu.parallel import sharding as shd  # noqa: E402
+from rayfed_tpu.parallel.ring import ring_attention  # noqa: E402
+from rayfed_tpu.parallel.train import make_fed_train_step  # noqa: E402
 
 
 def seq_mesh(n=8):

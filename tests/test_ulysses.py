@@ -25,11 +25,18 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from rayfed_tpu.models import transformer as tfm
-from rayfed_tpu.parallel.ulysses import (
+try:
+    from jax import shard_map
+except ImportError:
+    pytest.skip(
+        "requires jax >= 0.7 (top-level jax.shard_map API)",
+        allow_module_level=True,
+    )
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from rayfed_tpu.models import transformer as tfm  # noqa: E402
+from rayfed_tpu.parallel.ulysses import (  # noqa: E402
     reference_full_attention,
     ulysses_attention,
 )

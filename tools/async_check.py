@@ -1,0 +1,130 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Straggler-proof throughput gate for buffered-async aggregation.
+
+Runs bench.py's 3-party async stage (spawned processes, real TCP
+transport, carol's every send delayed by a seeded fault schedule) and
+FAILS LOUDLY — exit code 1 — when buffered-async rounds stop beating
+the lock-step baseline. Wire this into CI so a change that quietly
+re-serializes the fold path (an actor lane in front of the aggregator,
+a blocking fetch inside ``async_round``, a publish that waits for the
+straggler) turns the build red.
+
+Two gates, both over the BEST repetition ("can the code still go this
+fast", not "was the shared runner busy"):
+
+  ratio — ``async_rounds_s / sync_rounds_s`` must stay >= the budget.
+          With a 400 ms straggler delay and ~0.18 s lock-step rounds,
+          the measured ratio is ~60x on a quiet host; the default 3.0
+          floor is the ISSUE acceptance line, ~20x of headroom.
+  floor — ``async_rounds_s`` absolute rounds/s, so the ratio cannot be
+          satisfied by making SYNC slower.
+
+A total wall-clock budget bounds the whole check so a hang (a stranded
+straggler offer, a stuck dial) fails fast instead of eating the CI job
+timeout.
+
+Budgets:
+
+  FEDTPU_ASYNC_BUDGET_RATIO   default 3.0 — async/sync rounds/s floor.
+  FEDTPU_ASYNC_BUDGET_FLOOR   default 20.0 — async rounds/s floor
+                              (measured ~370 on a quiet 2-core host).
+  FEDTPU_ASYNC_ROUNDS         default 12 rounds per window.
+  FEDTPU_ASYNC_REPS           default 2; the best repetition gates.
+  FEDTPU_ASYNC_DELAY_MS       default 400 — carol's max injected delay.
+  FEDTPU_ASYNC_WALL_BUDGET_S  default 300 — hard cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    ratio_budget = float(os.environ.get("FEDTPU_ASYNC_BUDGET_RATIO", "3.0"))
+    floor = float(os.environ.get("FEDTPU_ASYNC_BUDGET_FLOOR", "20.0"))
+    rounds = int(os.environ.get("FEDTPU_ASYNC_ROUNDS", "12"))
+    reps = os.environ.get("FEDTPU_ASYNC_REPS", "2")
+    delay_ms = os.environ.get("FEDTPU_ASYNC_DELAY_MS", "400")
+    wall_budget_s = float(os.environ.get("FEDTPU_ASYNC_WALL_BUDGET_S", "300"))
+
+    # The bench stage reads its knobs from the FEDTPU_BENCH_* namespace.
+    os.environ["FEDTPU_BENCH_ASYNC_REPS"] = reps
+    os.environ["FEDTPU_BENCH_ASYNC_DELAY_MS"] = delay_ms
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            bench._async_party, "tcp", (rounds,),
+            timeout_s=wall_budget_s, parties=bench._ASYNC3,
+        )
+    elapsed = time.monotonic() - t0
+    if elapsed > wall_budget_s:
+        print(
+            f"ASYNC GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed exceeds "
+            f"the {wall_budget_s:.0f}s budget — a stranded straggler offer "
+            f"or stuck dial, not just a slow host.",
+            file=sys.stderr,
+        )
+        return 1
+
+    ratio = res["async_vs_sync"]
+    async_s = res["async_rounds_s"]
+    print(
+        f"async={async_s:.1f} rounds/s (spread "
+        f"{[round(x, 1) for x in res['async_rounds_s_spread']]}) "
+        f"sync={res['sync_rounds_s']:.2f} rounds/s (spread "
+        f"{[round(x, 2) for x in res['sync_rounds_s_spread']]}) "
+        f"ratio={ratio:.1f}x delay={res['straggler_delay_ms']}ms "
+        f"in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    failed = False
+    if ratio < ratio_budget:
+        failed = True
+        print(
+            f"ASYNC REGRESSION: async_vs_sync {ratio:.2f}x is under the "
+            f"{ratio_budget:.2f}x budget. Buffered-async rounds are "
+            f"waiting out the straggler again: check that offers still "
+            f"run on the stealable pool (not a serial actor lane), that "
+            f"the K-publish fires without carol's contribution, and that "
+            f"async_round issues offers without fetching.",
+            file=sys.stderr,
+        )
+    if async_s < floor:
+        failed = True
+        print(
+            f"ASYNC REGRESSION: async_rounds_s {async_s:.1f} is under the "
+            f"{floor:.1f} rounds/s floor — the ratio gate alone could be "
+            f"met by slowing sync down; this one cannot.",
+            file=sys.stderr,
+        )
+    if failed:
+        return 1
+    print(f"async gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
