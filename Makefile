@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma serve async clean
+.PHONY: native test lint chaos latency scale dma serve async churn clean
 
 native:
 	python setup.py build_ext --inplace
@@ -69,6 +69,16 @@ serve:
 # .github/workflows/tests.yml.
 async:
 	JAX_PLATFORMS=cpu python tools/async_check.py
+
+# Churn gate (docs/membership.md): elastic membership under fire — one
+# party crash-killed mid-round and liveness-evicted, a replacement
+# joining mid-training via fed.join. churn_rounds_lost must stay 0,
+# the replacement must take over, and churn_join_ms must stay under
+# budget, plus the spawn-based membership lifecycle tests. Mirrors the
+# `churn` job in .github/workflows/tests.yml.
+churn:
+	JAX_PLATFORMS=cpu python tools/churn_check.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_membership.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

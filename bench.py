@@ -1543,6 +1543,186 @@ def _async_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_CHURN5 = ("alice", "bob", "carol", "dave", "erin")
+
+
+def _churn_party(party, addresses, transport, result_path, rounds):
+    """Elastic-membership churn lifecycle (docs/membership.md): a
+    4-party FedAvg where dave is crash-killed mid-round by an injected
+    fault, evicted at the next sync by the liveness monitor's DEAD
+    verdict, and erin joins as its replacement mid-training via
+    ``fed.join``. Headline metrics tools/churn_check.py gates:
+
+      churn_join_ms    — fed.join() call to the joiner's FIRST completed
+                         contribution round (handshake + admission bump
+                         + one elastic round).
+      churn_rounds_lost — rounds that aggregated zero contributors on
+                         the coordinator (must be 0: churn must degrade
+                         rounds, never lose them).
+    """
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.ops.aggregate import elastic_weighted_mean
+    from rayfed_tpu.resilience.liveness import DEAD
+
+    crash_round = 3  # dave pushes to 3 peers/round; 10th push crashes
+    join_trigger = 4  # erin dials in while the eviction is in flight
+    marker_dir = os.path.dirname(result_path)
+    bases = {"alice": 1.0, "bob": 2.0, "carol": 3.0, "dave": 4.0,
+             "erin": 5.0}
+    comm = {
+        "retry_policy": {
+            "max_attempts": 2,
+            "initial_backoff_ms": 50,
+            "max_backoff_ms": 100,
+        },
+        "timeout_in_ms": 2000,
+        "recv_timeout_in_ms": 2000,
+        "send_deadline_in_ms": 4000,
+    }
+    resilience = {
+        "liveness": {
+            "interval_ms": 100, "suspect_after": 2, "dead_after": 4,
+            "timeout_ms": 300,
+        },
+    }
+    membership = {
+        "coordinator": "alice",
+        "auth_token": "bench-churn",
+        "evict_dead": True,
+        "sync_timeout_s": 30.0,
+    }
+    job_name = f"bench-churn-{transport}"
+
+    @fed.remote
+    def contrib(base, r):
+        return {"g": np.full((1 << 12,), base * (r + 1), np.float32)}
+
+    def one_round(r, view):
+        roster = sorted(view.roster)
+        objs = {p: contrib.party(p).remote(bases[p], r) for p in roster}
+        got = fed.get([objs[p] for p in roster], timeout=3.0,
+                      on_missing="default")
+        contribs = dict(zip(roster, got))
+        live = fed.liveness_view()
+        agg = elastic_weighted_mean(contribs, liveness=live)
+        assert np.isfinite(np.asarray(agg["g"]).sum())
+        return [p for p in roster
+                if contribs[p] is not fed.MISSING and live.get(p) != DEAD]
+
+    if party == "erin":
+        trigger = os.path.join(marker_dir, f"round-{join_trigger}")
+        deadline = time.monotonic() + 120
+        while not os.path.exists(trigger):
+            if time.monotonic() > deadline:
+                raise RuntimeError("founders never reached the join round")
+            time.sleep(0.05)
+        from rayfed_tpu.membership.manager import get_membership_manager
+
+        t_join = time.monotonic()
+        fed.join(
+            address=addresses["erin"],
+            party="erin",
+            coordinator="alice",
+            coordinator_address=addresses["alice"],
+            config={
+                "cross_silo_comm": dict(comm),
+                "transport": transport,
+                "resilience": dict(resilience),
+                "membership": dict(membership),
+            },
+            job_name=job_name,
+            logging_level="error",
+            timeout=90.0,
+        )
+        entry = get_membership_manager().sync_index() - 1
+        join_ms = None
+        for r in range(entry, rounds):
+            view = (fed.membership_view() if r == entry
+                    else fed.membership_sync(timeout=30.0))
+            one_round(r, view)
+            if join_ms is None:
+                join_ms = (time.monotonic() - t_join) * 1e3
+            time.sleep(0.25)
+        # Sidecar for the coordinator's result merge (atomic: alice may
+        # already be polling for it).
+        tmp = result_path + ".erin.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"churn_join_ms": join_ms, "entry": entry}, f)
+        os.replace(tmp, result_path + ".erin")
+        fed.shutdown()
+        return
+
+    founders = {p: a for p, a in addresses.items() if p != "erin"}
+    config = {
+        "barrier_on_initializing": True,
+        "cross_silo_comm": dict(comm),
+        "transport": transport,
+        "resilience": dict(resilience),
+        "membership": dict(membership),
+    }
+    if party == "dave":
+        config["cross_silo_comm"]["exit_on_sending_failure"] = True
+        config["resilience"]["fault_schedule"] = {
+            "seed": 7,
+            "rules": [{"fault": "crash", "src": "dave",
+                       "after": 3 * crash_round}],
+        }
+    fed.init(
+        addresses=founders,
+        party=party,
+        config=config,
+        job_name=job_name,
+        logging_level="error",
+        sending_failure_handler=(
+            (lambda e: os._exit(0)) if party == "dave" else None
+        ),
+    )
+    per_round = []
+    last_view = None
+    try:
+        for r in range(rounds):
+            view = fed.membership_sync(timeout=30.0)
+            last_view = view
+            contributors = one_round(r, view)
+            per_round.append(contributors)
+            if party == "alice":
+                with open(os.path.join(marker_dir, f"round-{r}"), "w"):
+                    pass
+            time.sleep(0.25)
+    except BaseException:
+        if party == "dave" and len(per_round) >= crash_round - 1:
+            os._exit(0)  # expected death throes after the injected crash
+        raise
+    if party == "dave":
+        raise AssertionError("dave survived its own crash schedule")
+    if party == "alice":
+        erin_path = result_path + ".erin"
+        deadline = time.monotonic() + 60
+        while not os.path.exists(erin_path):
+            if time.monotonic() > deadline:
+                raise RuntimeError("joiner never reported its sidecar")
+            time.sleep(0.1)
+        with open(erin_path) as f:
+            erin_res = json.load(f)
+        final_roster = sorted(last_view.roster)
+        replaced = ("erin" in final_roster and "dave" not in final_roster
+                    and "erin" in per_round[-1])
+        with open(result_path, "w") as f:
+            json.dump({
+                "churn_join_ms": erin_res["churn_join_ms"],
+                "churn_rounds_lost": sum(
+                    1 for c in per_round if not c
+                ),
+                "churn_replaced": int(replaced),
+                "churn_epoch": last_view.epoch,
+                "churn_entry_round": erin_res["entry"],
+                "churn_rounds": rounds,
+            }, f)
+    fed.shutdown()
+
+
 def _try_build_fastwire() -> None:
     """Best-effort build of the native C++ IO lane; the transport falls
     back to pure-Python sockets if this fails."""
@@ -1774,6 +1954,22 @@ def main() -> None:
             "async_rounds_s_spread": "async_rounds_s_spread",
             "sync_rounds_s_spread": "sync_rounds_s_spread",
             "async_vs_sync": "async_vs_sync",
+        },
+    ))
+    # Elastic-membership churn (docs/membership.md): dave crash-killed
+    # mid-round, liveness-evicted at the next sync, erin joins as its
+    # replacement mid-training. tools/churn_check.py gates join latency
+    # and rounds lost.
+    result.update(_bench_stage(
+        _churn_party, "churn_join_ms", "FEDTPU_BENCH_CHURN_ROUNDS", 12,
+        [("tcp", "churn_join_ms")], cpu_force=True, parties=_CHURN5,
+        timeout_s=300, digits=1,
+        extra_fields={
+            "churn_rounds_lost": "churn_rounds_lost",
+            "churn_replaced": "churn_replaced",
+            "churn_epoch": "churn_epoch",
+            "churn_entry_round": "churn_entry_round",
+            "churn_rounds": "churn_rounds",
         },
     ))
     # N-party scale sweep (in-process simulated parties, real wire edges).

@@ -27,7 +27,11 @@ from rayfed_tpu.api import (  # noqa: F401
     get,
     init,
     is_party_leader,
+    join,
     kill,
+    leave,
+    membership_sync,
+    membership_view,
     remote,
     shutdown,
 )
@@ -67,6 +71,10 @@ __all__ = [
     "fault_trace",
     "liveness_view",
     "party_state",
+    "join",
+    "leave",
+    "membership_sync",
+    "membership_view",
     "serve",
     "submit_request",
     "ServeHandle",

@@ -100,6 +100,14 @@ class GlobalContext:
         with self._seq_lock:
             return self._seq_count
 
+    def reset_seq_id(self, value: int = 0) -> None:
+        """Restart the DAG numbering — ONLY safe at a membership epoch
+        bump, where every party resets at the same program point and the
+        barrier layer's epoch stamp keeps old-numbered in-flight frames
+        in a disjoint key space."""
+        with self._seq_lock:
+            self._seq_count = value
+
     # -- cleanup / failure bookkeeping ------------------------------------
     def get_cleanup_manager(self):
         return self._cleanup_manager

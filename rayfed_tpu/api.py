@@ -357,6 +357,34 @@ def init(
                 _liveness.LivenessConfig.from_dict(liveness_dict),
             )
 
+    # Elastic membership (docs/membership.md): every founding party builds
+    # the same epoch-0 view from the init addresses and installs the
+    # manager's engine hooks (seq-id epoch stamp, rendezvous roster,
+    # coordinator control handler + liveness DEAD escalation). AFTER the
+    # resilience block — the coordinator's manager wires itself onto the
+    # just-started monitor. Leader-only, like the proxies it governs.
+    membership_dict = config.get("membership")
+    if membership_dict is not None and party_process_id == 0:
+        from rayfed_tpu.membership import (
+            MembershipConfig,
+            MembershipManager,
+            MembershipView,
+            set_membership_manager,
+        )
+
+        membership_manager = MembershipManager(
+            job_name,
+            party,
+            MembershipView(
+                epoch=0,
+                roster=tuple(sorted(addresses)),
+                addresses=dict(addresses),
+            ),
+            MembershipConfig.from_dict(membership_dict),
+        )
+        membership_manager.install()
+        set_membership_manager(membership_manager)
+
     if config.get("barrier_on_initializing", False) and party_process_id == 0:
         barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
 
@@ -400,18 +428,25 @@ def _shutdown(intended: bool = True):
             failure_handler(last_sending_error)
         exit_on_sending_failure = ctx.get_exit_on_sending_failure()
 
-    internal_kv.kv_reset()
-    clear_global_context(wait_for_sending=wait_for_sending)
-    # Resilience teardown before the proxies go away: heartbeats must not
-    # probe a stopped sender, and uninstalling the injector restores the
-    # real proxy so stop_proxies stops what init started. The modules are
-    # always importable here (config.py pulls the package in), and both
-    # calls are no-ops when init never enabled them.
+    # Resilience teardown FIRST — before the send drain and long before
+    # the proxies go away: a heartbeat tick landing mid-teardown would
+    # count misses against peers that are merely shutting down too (and
+    # log spurious SUSPECT verdicts), and uninstalling the injector
+    # restores the real proxy so stop_proxies stops what init started.
+    # The modules are always importable here (config.py pulls the package
+    # in), and both calls are no-ops when init never enabled them.
     from rayfed_tpu.resilience import inject as _inject
     from rayfed_tpu.resilience import liveness as _liveness
 
     _liveness.stop_monitor()
     _inject.uninstall()
+    # Membership hooks next (seq-id epoch stamp, rendezvous control
+    # handler/roster): the drain below must run against the bare engine.
+    _membership = sys.modules.get("rayfed_tpu.membership.manager")
+    if _membership is not None:
+        _membership.clear_membership_manager()
+    internal_kv.kv_reset()
+    clear_global_context(wait_for_sending=wait_for_sending)
     from rayfed_tpu import topology as _topology
 
     _topology.reset_default()
@@ -443,6 +478,124 @@ def _shutdown(intended: bool = True):
     if exit_on_sending_failure:
         logger.critical("Exit now due to the previous error.")
         sys.exit(1)
+
+
+def join(
+    address: str,
+    party: str,
+    coordinator: str,
+    coordinator_address: str,
+    config: Optional[Dict] = None,
+    tls_config: Optional[Dict] = None,
+    logging_level: str = "info",
+    job_name: Optional[str] = None,
+    transport: Optional[str] = None,
+    timeout: Optional[float] = None,
+) -> Any:
+    """Join a RUNNING membership-enabled job mid-training.
+
+    Boots a minimal two-party runtime ({this party, the coordinator}),
+    then runs the join handshake: authenticate with the coordinator
+    (``config['membership']['auth_token']`` must match the job's), park
+    on the JoinAccept the coordinator emits at its next
+    ``fed.membership_sync()``, install the received view (full roster,
+    addresses, ghost tables, sync index), re-key the seq-id space to the
+    admitting epoch, and warm-dial every peer.
+
+    Returns the bootstrap state the coordinator attached to the accept —
+    ``{"kind": "provider"|"checkpoint"|"model_bank", ...}`` or None —
+    which the driver uses to enter the training loop at the current
+    round. The joiner already holds the view of the sync that admitted
+    it, so its driver SKIPS the membership_sync of its entry round and
+    resumes the per-round sync with everyone else from the next round on.
+
+    Args:
+        address: this party's listen address ("host:port").
+        party: this party's name (must not collide with a roster member).
+        coordinator: the coordinator party's name.
+        coordinator_address: the coordinator's listen address.
+        config: job config dict, as in :func:`init`. The
+            ``membership`` sub-dict configures the handshake
+            (``auth_token``, ``join_timeout_s``); ``barrier_on_initializing``
+            is ignored (the handshake is the readiness barrier).
+        timeout: handshake deadline in seconds; defaults to
+            ``membership.join_timeout_s``.
+    """
+    from rayfed_tpu.membership import MembershipConfig
+    from rayfed_tpu.membership import manager as _mbr_manager
+
+    config = dict(config or {})
+    membership_config = MembershipConfig.from_dict(
+        config.pop("membership", None) or {}
+    )
+    if membership_config.coordinator is None:
+        membership_config.coordinator = coordinator
+    # The handshake below IS the readiness barrier (the request's ack
+    # proves the coordinator is up); the ping barrier would deadlock on
+    # roster members that are past init.
+    config.pop("barrier_on_initializing", None)
+    init(
+        addresses={party: address, coordinator: coordinator_address},
+        party=party,
+        config=config,
+        tls_config=tls_config,
+        logging_level=logging_level,
+        job_name=job_name,
+        transport=transport,
+    )
+    job = get_global_context().get_job_name()
+    _, bootstrap = _mbr_manager.join_handshake(
+        job, party, address, coordinator, membership_config, timeout=timeout
+    )
+    return bootstrap
+
+
+def leave(timeout: Optional[float] = None) -> None:
+    """Gracefully depart a membership-enabled job: notify the coordinator
+    (it removes this party from the roster at its next sync), then run
+    the ordinary intended shutdown — which drains in-flight sends and
+    releases this party's rendezvous entries with the proxies. Peers drop
+    the departed party at the eviction bump instead of waiting out a
+    liveness DEAD verdict."""
+    from rayfed_tpu.membership import manager as _mbr_manager
+
+    manager = _mbr_manager.get_membership_manager()
+    if manager is None:
+        raise RuntimeError(
+            "fed.leave() needs a membership-enabled job: pass "
+            "config={'membership': {...}} to fed.init, or enter via "
+            "fed.join"
+        )
+    manager.leave(timeout=timeout)
+    shutdown()
+
+
+def membership_sync(timeout: Optional[float] = None):
+    """One membership sync point — call at the SAME program point (a
+    round boundary) on every roster party. The coordinator folds pending
+    joins/leaves/evictions into the next view and broadcasts it; everyone
+    else receives and applies it. Returns the (possibly unchanged)
+    :class:`~rayfed_tpu.membership.MembershipView` now in force.
+    Consumes no data seq ids."""
+    from rayfed_tpu.membership import manager as _mbr_manager
+
+    manager = _mbr_manager.get_membership_manager()
+    if manager is None:
+        raise RuntimeError(
+            "fed.membership_sync() needs a membership-enabled job: pass "
+            "config={'membership': {...}} to fed.init, or enter via "
+            "fed.join"
+        )
+    return manager.membership_sync(timeout=timeout)
+
+
+def membership_view():
+    """This party's current membership view, or None on membership-free
+    jobs."""
+    from rayfed_tpu.membership import manager as _mbr_manager
+
+    manager = _mbr_manager.get_membership_manager()
+    return None if manager is None else manager.view()
 
 
 def _get_addresses(job_name: str) -> Dict[str, str]:

@@ -189,6 +189,7 @@ class BufferedAggregator:
         self.stats: Dict[str, int] = {
             "accepted": 0,
             "dropped_dead": 0,
+            "dropped_ghost": 0,
             "dropped_stale": 0,
             "publishes": 0,
             "publish_errors": 0,
@@ -213,24 +214,46 @@ class BufferedAggregator:
     # -- the one mutating entry point ---------------------------------------
 
     def offer(
-        self, party: str, tree: Any, *, round_tag: int, weight: float = 1.0
+        self,
+        party: str,
+        tree: Any,
+        *,
+        round_tag: int,
+        weight: float = 1.0,
+        epoch: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Fold one contribution into the buffer; publish on the Kth.
+
+        ``epoch`` is the membership epoch the offering driver stamped at
+        send time (None on membership-free jobs). On a membership-enabled
+        job, an offer from outside the current roster — or stamped with
+        an epoch predating the party's current incarnation (a pre-crash
+        ghost of a since-rejoined party) — is dropped before it can fold
+        into the buffer.
 
         Returns a small status dict (msgpack-clean scalars only — it
         rides the inline small-message lane back to the offering party):
         ``accepted``, ``reason`` (when not), ``staleness``, ``weight``
         (the effective post-decay weight), ``buffered``, ``version``.
         """
+        from rayfed_tpu.membership.manager import get_membership_manager
         from rayfed_tpu.resilience.liveness import DEAD, state_weight
 
         t0 = time.perf_counter()
         view = self._liveness_fn() if self._liveness_fn else {}
         state = view.get(party)
+        membership = get_membership_manager()
         tree = _snapshot_tree(tree)
         with self._lock:
             self._latest_tag = max(self._latest_tag, int(round_tag))
             staleness = self._latest_tag - int(round_tag)
+            if membership is not None and membership.is_ghost(party, epoch):
+                self.stats["dropped_ghost"] += 1
+                return {
+                    "accepted": False, "reason": "ghost",
+                    "staleness": staleness, "weight": 0.0,
+                    "buffered": len(self._buffer), "version": self.version,
+                }
             if state == DEAD:
                 self.stats["dropped_dead"] += 1
                 return {
@@ -415,9 +438,13 @@ def reset_sessions() -> None:
 
 
 @fed.remote
-def _async_offer(name, cfg_dict, serve_name, party, round_tag, weight, tree):
+def _async_offer(
+    name, cfg_dict, serve_name, party, round_tag, weight, epoch, tree
+):
     agg = _get_or_create_session(name, cfg_dict, serve_name)
-    return agg.offer(party, tree, round_tag=round_tag, weight=weight)
+    return agg.offer(
+        party, tree, round_tag=round_tag, weight=weight, epoch=epoch
+    )
 
 
 @fed.remote
@@ -566,10 +593,16 @@ def async_round(
     handle = AsyncRoundHandle(
         round_tag=int(round_tag), root=root, session=session
     )
+    # Stamp each offer with this driver's membership epoch (None on
+    # membership-free jobs): the root's aggregator rejects offers whose
+    # stamp predates the offering party's current incarnation.
+    from rayfed_tpu.membership.manager import current_epoch_or_none
+
+    epoch = current_epoch_or_none()
     for party in objs:
         w = 1.0 if weights is None else float(weights[party])
         handle.offers[party] = _async_offer.party(root).remote(
-            session, cfg_dict, serve_name, party, int(round_tag), w,
+            session, cfg_dict, serve_name, party, int(round_tag), w, epoch,
             objs[party],
         )
     if fetch_model:

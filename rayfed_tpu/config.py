@@ -496,3 +496,10 @@ class ServingConfig:
         data = data or {}
         field_names = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in data.items() if k in field_names})
+
+
+# MembershipConfig lives with the elastic-membership subsystem
+# (membership/config.py); re-exported here because job config classes are
+# historically spelled ``rayfed_tpu.config.<Name>`` (same pattern as
+# RetryPolicy above).
+from rayfed_tpu.membership.config import MembershipConfig  # noqa: E402,F401

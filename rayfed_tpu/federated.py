@@ -208,6 +208,17 @@ def fed_aggregate(
             from rayfed_tpu.resilience.liveness import DEAD
 
             dead = {p for p, st in liveness.items() if st == DEAD}
+        # Elastic membership: parties evicted (or departed) since the
+        # caller built ``objs`` are outside the current roster — exclude
+        # them like DEAD parties so the schedule re-plans over the
+        # members. Every party applied the same epoch bump at the same
+        # sync point, so every driver excludes identically.
+        from rayfed_tpu.membership.manager import get_membership_manager
+
+        membership = get_membership_manager()
+        if membership is not None:
+            roster = set(membership.roster())
+            dead |= {p for p in objs if p not in roster}
         plan = topo.plan(
             list(objs.keys()),
             topology or default_topo,

@@ -1,0 +1,91 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Membership-plane configuration (``config['membership']``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class MembershipConfig:
+    """Elastic-membership knobs (``config['membership']``, validated at
+    ``fed.init`` so a typo'd key rejects init, not the first sync;
+    docs/membership.md).
+
+    Attributes:
+        coordinator: the party owning the membership view (the
+            coordinator role). None = the root party by the planner's
+            convention: the lexicographically first party of the initial
+            roster — identical on every driver, so every party elects
+            the same coordinator without a message.
+        auth_token: shared join credential. When set, a ``fed.join``
+            handshake must present the identical token or the
+            coordinator rejects it with code 403 — the same trust bar
+            the ``cross_silo_comm`` identity config applies to data
+            frames (mutual-TLS deployments get transport-level identity
+            on top: a join request rides the data lane, so
+            ``verify_peer_identity`` already attests its ``src``).
+            None = any party that can reach the lane may join.
+        evict_dead: escalate a liveness DEAD verdict at the coordinator
+            to eviction at the next sync point (epoch bump, roster
+            removal, rendezvous ghost purge).
+        join_timeout_s: how long ``fed.join`` waits for the coordinator
+            to admit it at a sync point before giving up.
+        sync_timeout_s: how long a non-coordinator party waits for the
+            coordinator's view broadcast at each ``fed.membership_sync``.
+        bootstrap_dir: optional ``checkpoint.py`` base directory the
+            coordinator serves join bootstrap state from (the latest
+            ``step_<N>`` snapshot) when the driver registered no
+            bootstrap provider.
+    """
+
+    coordinator: Optional[str] = None
+    auth_token: Optional[str] = None
+    evict_dead: bool = True
+    join_timeout_s: float = 60.0
+    sync_timeout_s: float = 60.0
+    bootstrap_dir: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if float(self.join_timeout_s) <= 0:
+            raise ValueError(
+                f"membership.join_timeout_s must be > 0, got "
+                f"{self.join_timeout_s}"
+            )
+        if float(self.sync_timeout_s) <= 0:
+            raise ValueError(
+                f"membership.sync_timeout_s must be > 0, got "
+                f"{self.sync_timeout_s}"
+            )
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "MembershipConfig":
+        """Strict construction: unknown keys raise (this section is new —
+        there are no reference-written dicts to stay lenient for, and a
+        silently dropped ``auth_token`` typo would be an open door)."""
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(data) - field_names)
+        if unknown:
+            raise ValueError(
+                f"unknown membership config key(s) {unknown}; known keys: "
+                f"{sorted(field_names)}"
+            )
+        return cls(**data)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,591 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Per-party membership state and the join/leave/sync driving logic.
+
+One :class:`MembershipManager` lives on every party of a membership-
+enabled job (module singleton, wired by ``fed.init`` /  ``fed.join``).
+It owns the party's copy of the agreed view, the ghost tables
+(admission/eviction epochs per party), and the side effects an epoch
+bump applies to the rest of the engine:
+
+- cluster-config addresses (KV + module cache) — which parties a
+  ``fed.get`` owner-push fans out to;
+- sender-proxy peer set (``barriers.admit_peer`` / ``forget_peer``) —
+  which destinations the reactor pool will dial;
+- liveness monitor peer set;
+- rendezvous ghost purge (``rendezvous.evict_source_everywhere``);
+- the seq-id space: the driver-side counter resets to 0 and the barrier
+  layer stamps subsequent integer seq ids with the new epoch, so a
+  rejoining party can never collide with its pre-crash ghosts.
+
+The sync protocol (``fed.membership_sync()``, one call per round
+boundary on EVERY party — a seq-id-free collective): the coordinator
+folds its pending joins/leaves/evictions into a successor view and
+broadcasts it at the deterministic key ``("mbr:sync", sync_index)``;
+every other party recvs that key. The sync index is a per-driver
+monotonic counter advanced identically on all parties (multi-controller
+contract), and it is never reset — unlike data seq ids it survives epoch
+bumps, so a joiner admitted at sync S knows to recv sync S+1 next.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import rayfed_tpu._private.constants as constants
+import rayfed_tpu.config as fed_config
+from rayfed_tpu import tracing
+from rayfed_tpu._private import kv as internal_kv
+from rayfed_tpu._private.global_context import get_global_context
+from rayfed_tpu.membership import protocol
+from rayfed_tpu.membership.config import MembershipConfig
+from rayfed_tpu.membership.view import MembershipView
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_coordinator(config: MembershipConfig, roster) -> str:
+    """The coordinator party: configured name, else the root party by the
+    planner's convention (lexicographically first of the initial roster) —
+    identical on every driver, so every party elects the same coordinator
+    without a message."""
+    if config.coordinator is not None:
+        return config.coordinator
+    return sorted(roster)[0]
+
+
+class MembershipManager:
+    """This party's membership-plane state (see module docstring)."""
+
+    def __init__(
+        self,
+        job_name: str,
+        self_party: str,
+        view: MembershipView,
+        config: Optional[MembershipConfig] = None,
+        *,
+        sync_index: int = 0,
+        admissions: Optional[Dict[str, int]] = None,
+        evictions: Optional[Dict[str, int]] = None,
+    ) -> None:
+        self._job_name = job_name
+        self._self_party = self_party
+        self._config = config or MembershipConfig()
+        self._lock = threading.RLock()
+        self._view = view
+        self._sync_index = int(sync_index)
+        # Ghost tables. A party's ADMISSION epoch is the epoch of the
+        # bump that added it (0 for the initial roster); its EVICTION
+        # epoch is the epoch as of which it is out. An offer stamped
+        # with epoch e from party p is a ghost iff p is not in the
+        # roster, or e predates p's current incarnation (p rejoined
+        # after a crash and e belongs to the pre-crash self).
+        self._admissions: Dict[str, int] = dict(admissions or {})
+        self._evictions: Dict[str, int] = dict(evictions or {})
+        self._coordinator_name = resolve_coordinator(self._config, view.roster)
+        self._bootstrap_provider: Optional[Callable[[], Any]] = None
+        # The coordinator party's pending-change state; None elsewhere.
+        self._coordinator = None
+        if self._coordinator_name == self_party:
+            from rayfed_tpu.membership.coordinator import (
+                MembershipCoordinator,
+            )
+
+            self._coordinator = MembershipCoordinator(self)
+
+    # -- queries -------------------------------------------------------
+
+    @property
+    def job_name(self) -> str:
+        return self._job_name
+
+    @property
+    def self_party(self) -> str:
+        return self._self_party
+
+    @property
+    def config(self) -> MembershipConfig:
+        return self._config
+
+    def view(self) -> MembershipView:
+        with self._lock:
+            return self._view
+
+    def current_epoch(self) -> int:
+        """Registered as the barrier layer's seq-epoch hook: every
+        integer seq id sent or received while this manager is installed
+        is stamped ``e<epoch>:<n>``."""
+        with self._lock:
+            return self._view.epoch
+
+    def roster(self) -> Tuple[str, ...]:
+        with self._lock:
+            return self._view.roster
+
+    def sync_index(self) -> int:
+        with self._lock:
+            return self._sync_index
+
+    def coordinator(self) -> str:
+        return self._coordinator_name
+
+    def is_coordinator(self) -> bool:
+        return self._coordinator is not None
+
+    def get_coordinator_state(self):
+        return self._coordinator
+
+    def is_ghost(self, party: str, epoch: Optional[int]) -> bool:
+        """True when an offer stamped ``epoch`` from ``party`` belongs to
+        an evicted incarnation (see the ghost-table comment in
+        ``__init__``). ``epoch=None`` (a pre-membership driver) is never
+        a ghost unless the party itself is out of the roster."""
+        with self._lock:
+            if party not in self._view.roster:
+                return True
+            if epoch is None:
+                return False
+            return int(epoch) < int(self._admissions.get(party, 0))
+
+    def ghost_tables(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        with self._lock:
+            return dict(self._admissions), dict(self._evictions)
+
+    def plan(self, topology: Optional[str] = None,
+             group_size: Optional[int] = None):
+        """The aggregation plan over the CURRENT roster — what
+        ``fed_aggregate`` lowers to after this epoch's re-plan. Bitwise
+        identical to a fresh ``topology.plan`` over the same roster
+        (pinned by tests/test_membership.py)."""
+        from rayfed_tpu import topology as topo
+
+        with self._lock:
+            parties = list(self._view.roster)
+        return topo.plan(
+            parties,
+            topology or topo.get_default()[0],
+            group_size=group_size or topo.get_default()[1],
+        )
+
+    # -- bootstrap -----------------------------------------------------
+
+    def set_bootstrap_provider(self, fn: Optional[Callable[[], Any]]) -> None:
+        """Register the callable whose return value rides each
+        JoinAccept as the joiner's bootstrap state (e.g. the current
+        global model + round index). Overrides the ``bootstrap_dir``
+        checkpoint fallback and the live ModelBank fallback."""
+        self._bootstrap_provider = fn
+
+    def make_bootstrap(self) -> Any:
+        """Bootstrap state for a JoinAccept, by priority: the registered
+        provider, else the newest ``checkpoint.py`` snapshot under
+        ``membership.bootstrap_dir``, else the newest live ModelBank
+        version on this party, else None."""
+        if self._bootstrap_provider is not None:
+            return {"kind": "provider", "state": self._bootstrap_provider()}
+        if self._config.bootstrap_dir:
+            try:
+                from rayfed_tpu import checkpoint
+
+                step = checkpoint.latest_step(self._config.bootstrap_dir)
+                if step is not None:
+                    return {
+                        "kind": "checkpoint",
+                        "base_dir": self._config.bootstrap_dir,
+                        "step": int(step),
+                        "path": checkpoint.step_dir(
+                            self._config.bootstrap_dir, step
+                        ),
+                    }
+            except Exception:  # noqa: BLE001 - bootstrap is best-effort
+                logger.warning(
+                    "membership: checkpoint bootstrap lookup failed",
+                    exc_info=True,
+                )
+        import sys as _sys
+
+        server_mod = _sys.modules.get("rayfed_tpu.serving.server")
+        if server_mod is not None:
+            try:
+                for name in sorted(server_mod._servers):
+                    bank = server_mod._servers[name].bank
+                    if bank.current_version() > 0:
+                        version, params = bank.acquire()
+                        try:
+                            return {
+                                "kind": "model_bank",
+                                "serve_name": name,
+                                "version": int(version),
+                                "params": params,
+                            }
+                        finally:
+                            bank.release(version)
+            except Exception:  # noqa: BLE001 - bootstrap is best-effort
+                logger.warning(
+                    "membership: ModelBank bootstrap lookup failed",
+                    exc_info=True,
+                )
+        return None
+
+    # -- engine wiring -------------------------------------------------
+
+    def install(self) -> None:
+        """Register this manager's hooks with the rest of the engine:
+        the barrier layer's seq-epoch stamp, the rendezvous roster (for
+        ghost expiry), and — on the coordinator — the control-frame
+        handler and the liveness DEAD escalation."""
+        from rayfed_tpu.proxy import barriers, rendezvous
+
+        barriers.set_seq_epoch_fn(self.current_epoch)
+        rendezvous.set_roster_fn(
+            self._job_name, lambda: set(self.roster())
+        )
+        if self._coordinator is not None:
+            rendezvous.set_control_handler(
+                self._job_name, self._coordinator.handle_control
+            )
+            from rayfed_tpu.resilience import liveness
+
+            monitor = liveness.get_monitor()
+            if monitor is not None and self._config.evict_dead:
+                monitor.set_on_dead(self._coordinator.note_dead)
+
+    def uninstall(self) -> None:
+        from rayfed_tpu.proxy import barriers, rendezvous
+
+        barriers.clear_seq_epoch_fn()
+        rendezvous.clear_roster_fn(self._job_name)
+        rendezvous.clear_control_handler(self._job_name)
+        from rayfed_tpu.resilience import liveness
+
+        monitor = liveness.get_monitor()
+        if monitor is not None:
+            monitor.set_on_dead(None)
+
+    # -- the sync point ------------------------------------------------
+
+    def membership_sync(
+        self, timeout: Optional[float] = None
+    ) -> MembershipView:
+        """One membership sync: every roster party calls this at the
+        same program point (a round boundary). Advances the sync index,
+        then either folds-and-broadcasts (coordinator) or receives-and-
+        applies (member). Consumes NO data seq ids — the sync key is the
+        string pair ``("mbr:sync", <sync_index>)``."""
+        with self._lock:
+            self._sync_index += 1
+            idx = self._sync_index
+        if self._coordinator is not None:
+            return self._coordinator.run_sync(idx)
+        from rayfed_tpu.proxy import barriers
+
+        fut = barriers.recv(
+            self._self_party,
+            self._coordinator_name,
+            protocol.SYNC_SEQ,
+            str(idx),
+        )
+        msg = fut.result(
+            timeout=timeout
+            if timeout is not None
+            else self._config.sync_timeout_s
+        )
+        return self.apply_sync_msg(msg)
+
+    def apply_sync_msg(self, msg: Dict) -> MembershipView:
+        new_view = MembershipView.from_wire(msg["view"])
+        admitted = dict(msg.get("admitted") or {})
+        evicted = {
+            p: int(e) for p, e in (msg.get("evicted") or {}).items()
+        }
+        with self._lock:
+            if new_view.epoch == self._view.epoch:
+                return self._view
+            if new_view.epoch < self._view.epoch:
+                raise RuntimeError(
+                    f"membership sync went backwards: applied epoch "
+                    f"{self._view.epoch}, received {new_view.epoch}"
+                )
+            return self._apply_bump_locked(new_view, admitted, evicted)
+
+    def _apply_bump_locked(
+        self,
+        new_view: MembershipView,
+        admitted: Dict[str, str],
+        evicted: Dict[str, int],
+    ) -> MembershipView:
+        """Install a successor view and apply its side effects. Caller
+        holds the lock; the side effects below touch only module-level
+        seams (KV, proxies, monitor) that take their own locks."""
+        old_epoch = self._view.epoch
+        for p, e in evicted.items():
+            self._evictions[p] = int(e)
+            self._admissions.pop(p, None)
+        for p in admitted:
+            self._admissions[p] = new_view.epoch
+            self._evictions.pop(p, None)
+        self._view = new_view
+
+        from rayfed_tpu.proxy import barriers, rendezvous
+
+        # Addresses first: the cluster config is what fed.get broadcasts
+        # and new sender workers dial from.
+        self._store_addresses_locked(new_view.addresses)
+        from rayfed_tpu.resilience import liveness
+
+        monitor = liveness.get_monitor()
+        for p, addr in admitted.items():
+            if p == self._self_party:
+                continue
+            barriers.admit_peer(p, addr)
+            if monitor is not None:
+                monitor.add_peer(p)
+        for p in evicted:
+            if p == self._self_party:
+                continue
+            barriers.forget_peer(p)
+            if monitor is not None:
+                monitor.remove_peer(p)
+            # Purge the evicted party's parked frames NOW — the expire
+            # loop's roster sweep is the safety net for stores without
+            # one running.
+            rendezvous.evict_source_everywhere(self._job_name, p)
+
+        # Re-key the seq-id space: the driver-side counter restarts at 0
+        # and the barrier layer stamps the new epoch onto every integer
+        # seq id from here on. Every party performs this at its own sync
+        # call — the same program point — so the DAG numbering stays
+        # aligned across the bump.
+        ctx = get_global_context()
+        if ctx is not None:
+            ctx.reset_seq_id()
+
+        now = time.perf_counter()
+        for p in admitted:
+            tracing.record(
+                "membership", p, f"epoch:{old_epoch}",
+                f"epoch:{new_view.epoch}", 0, now, event="join",
+            )
+        for p in evicted:
+            tracing.record(
+                "membership", p, f"epoch:{old_epoch}",
+                f"epoch:{new_view.epoch}", 0, now, event="evict",
+            )
+        tracing.record(
+            "membership", self._self_party, f"epoch:{old_epoch}",
+            f"epoch:{new_view.epoch}", 0, now, event="epoch-bump",
+            roster=list(new_view.roster),
+        )
+        logger.info(
+            "membership epoch %d -> %d: roster=%s admitted=%s evicted=%s",
+            old_epoch, new_view.epoch, list(new_view.roster),
+            sorted(admitted), sorted(evicted),
+        )
+        return new_view
+
+    def _store_addresses_locked(self, addresses: Dict[str, str]) -> None:
+        """Rewrite the KV cluster config with the new roster addresses
+        (preserving party identity and TLS) and drop the module cache so
+        the next ``get_cluster_config`` re-reads it."""
+        cfg = fed_config.get_cluster_config(self._job_name)
+        tls = cfg.tls_config if cfg is not None else {}
+        cluster_config = {
+            constants.KEY_OF_CLUSTER_ADDRESSES: dict(addresses),
+            constants.KEY_OF_CURRENT_PARTY_NAME: self._self_party,
+            constants.KEY_OF_TLS_CONFIG: tls,
+        }
+        internal_kv.kv_put(
+            self._job_name,
+            constants.KEY_OF_CLUSTER_CONFIG,
+            pickle.dumps(cluster_config),
+        )
+        fed_config.reset_config_cache()
+
+    # -- graceful departure -------------------------------------------
+
+    def leave(self, timeout: Optional[float] = None) -> None:
+        """Graceful departure: tell the coordinator (it removes us at
+        its next sync), then stop participating. The caller (fed.leave)
+        tears the runtime down afterwards — the cleanup manager drains
+        in-flight sends there, and shutdown releases our rendezvous
+        entries with the proxies."""
+        if self._coordinator is not None:
+            raise RuntimeError(
+                "the coordinator party cannot leave the job it "
+                "coordinates (hand the role off by restarting the job "
+                "with a different membership.coordinator)"
+            )
+        from rayfed_tpu.proxy import barriers
+
+        nonce = protocol.new_nonce()
+        fut = barriers.send(
+            self._coordinator_name,
+            protocol.make_leave_request(self._self_party, nonce),
+            protocol.LEAVE_REQ_SEQ,
+            nonce,
+        )
+        try:
+            fut.result(
+                timeout=timeout
+                if timeout is not None
+                else self._config.sync_timeout_s
+            )
+        except Exception:  # noqa: BLE001 - departure is best-effort: an
+            # unreachable coordinator will evict us via liveness anyway
+            logger.warning(
+                "membership: leave notification to coordinator %s failed "
+                "(liveness eviction will reap this party instead)",
+                self._coordinator_name, exc_info=True,
+            )
+        tracing.record(
+            "membership", self._self_party,
+            f"epoch:{self.current_epoch()}", "left", 0,
+            time.perf_counter(), event="leave",
+        )
+
+
+# -- joiner handshake --------------------------------------------------
+
+
+def join_handshake(
+    job_name: str,
+    self_party: str,
+    self_address: str,
+    coordinator_party: str,
+    config: MembershipConfig,
+    timeout: Optional[float] = None,
+) -> Tuple[MembershipManager, Any]:
+    """Run the join handshake against an already-initialized two-party
+    runtime ({self, coordinator}): send a JoinRequest, park on the
+    JoinAccept, then build + install the manager and admit the full
+    roster. Returns ``(manager, bootstrap)``.
+
+    The accept arrives at the coordinator's NEXT sync point, where the
+    whole roster's epoch bump admits us — so by the time this returns,
+    every member party has (or is applying) a view containing us, our
+    seq counter is 0, and our epoch stamp matches theirs.
+    """
+    from rayfed_tpu.proxy import barriers
+
+    timeout = timeout if timeout is not None else config.join_timeout_s
+    deadline = time.monotonic() + timeout
+    nonce = protocol.new_nonce()
+    # Park on the accept BEFORE the request is acked: the coordinator's
+    # sync may fire between ack and a later recv registration, and the
+    # accept must find a waiter (or park as arrived) either way.
+    accept_fut = barriers.recv(
+        self_party, coordinator_party, protocol.RESPONSE_SEQ, nonce
+    )
+    req_fut = barriers.send(
+        coordinator_party,
+        protocol.make_join_request(
+            self_party, self_address, nonce, config.auth_token
+        ),
+        protocol.JOIN_REQ_SEQ,
+        nonce,
+    )
+    # The request's ack carries the control handler's verdict: a 403
+    # (bad token) fails this future immediately, long before the accept
+    # timeout would expire.
+    req_fut.result(timeout=max(0.1, deadline - time.monotonic()))
+    accept = accept_fut.result(
+        timeout=max(0.1, deadline - time.monotonic())
+    )
+    if not isinstance(accept, dict) or accept.get("kind") != "join-accept":
+        raise RuntimeError(
+            f"malformed join accept from coordinator: {type(accept)}"
+        )
+
+    view = MembershipView.from_wire(accept["view"])
+    manager = MembershipManager(
+        job_name,
+        self_party,
+        view,
+        config,
+        sync_index=int(accept["sync_index"]),
+        admissions=accept.get("admissions") or {},
+        evictions=accept.get("evictions") or {},
+    )
+    # Admit the full roster locally: addresses into the KV config and
+    # the sender proxy, peers into the liveness monitor.
+    manager._store_addresses_locked(view.addresses)
+    from rayfed_tpu.resilience import liveness
+
+    monitor = liveness.get_monitor()
+    for p, addr in view.addresses.items():
+        if p == self_party:
+            continue
+        barriers.admit_peer(p, addr)
+        if monitor is not None:
+            monitor.add_peer(p)
+    # Align the seq-id space with the epoch bump that admitted us: every
+    # member reset to 0 at that bump; we start there too.
+    ctx = get_global_context()
+    if ctx is not None:
+        ctx.reset_seq_id()
+    manager.install()
+    set_membership_manager(manager)
+    # Warm the reactor dial to every peer (best-effort — the data lane
+    # dials lazily on first send regardless).
+    for p in view.roster:
+        if p != self_party:
+            try:
+                barriers.send_ping(p)
+            except Exception:  # noqa: BLE001 - lazy dial covers it
+                pass
+    tracing.record(
+        "membership", self_party, "join",
+        f"epoch:{view.epoch}", 0, time.perf_counter(), event="joined",
+        sync_index=manager.sync_index(),
+    )
+    logger.info(
+        "membership: joined job %r as %r at epoch %d (roster=%s)",
+        job_name, self_party, view.epoch, list(view.roster),
+    )
+    return manager, accept.get("bootstrap")
+
+
+# -- module singleton wired by fed.init / fed.join ---------------------
+
+_manager: Optional[MembershipManager] = None
+
+
+def set_membership_manager(manager: Optional[MembershipManager]) -> None:
+    global _manager
+    _manager = manager
+
+
+def get_membership_manager() -> Optional[MembershipManager]:
+    return _manager
+
+
+def clear_membership_manager() -> None:
+    global _manager
+    if _manager is not None:
+        try:
+            _manager.uninstall()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            logger.warning("membership uninstall failed", exc_info=True)
+    _manager = None
+
+
+def current_epoch_or_none() -> Optional[int]:
+    """The installed manager's epoch, or None on membership-free jobs —
+    the stamp the async plane attaches to offers."""
+    return None if _manager is None else _manager.current_epoch()

@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import time
 from concurrent.futures import Future
-from typing import Dict, Optional, Type
+from typing import Callable, Dict, Optional, Type
 
 from rayfed_tpu._private.constants import PING_SEQ_ID
 from rayfed_tpu._private.global_context import get_global_context
@@ -106,6 +106,75 @@ def sender_proxy() -> Optional[SenderProxy]:
 
 def receiver_proxy() -> Optional[ReceiverProxy]:
     return _receiver_proxy
+
+
+# Epoch stamp for the seq-id space (elastic membership,
+# rayfed_tpu/membership/). While a membership manager is installed it
+# registers its epoch query here; send/recv then wrap every INTEGER seq
+# id as "e<epoch>:<n>". A send and its matching recv sit at the same
+# program point of the same driver program, so both sides stamp the same
+# epoch — and after an epoch bump resets the driver-side counter to 0, a
+# frame from the pre-bump incarnation parks under its old-epoch key and
+# can never collide with post-bump traffic. String seq ids (the "ping"
+# probe, the "mbr:*" membership namespace, resent error envelopes) pass
+# through unchanged, as does everything on membership-free jobs (no fn
+# registered = no behavior change).
+_seq_epoch_fn: Optional[Callable[[], Optional[int]]] = None
+
+
+def set_seq_epoch_fn(fn: Callable[[], Optional[int]]) -> None:
+    global _seq_epoch_fn
+    _seq_epoch_fn = fn
+
+
+def clear_seq_epoch_fn() -> None:
+    global _seq_epoch_fn
+    _seq_epoch_fn = None
+
+
+def _stamp_epoch(seq_id):
+    fn = _seq_epoch_fn
+    if fn is None or not isinstance(seq_id, int):
+        return seq_id
+    epoch = fn()
+    if epoch is None:
+        return seq_id
+    return f"e{epoch}:{seq_id}"
+
+
+def admit_peer(party: str, address: str) -> None:
+    """Teach the CURRENT sender proxy a new destination (elastic
+    membership admission). The transports dial lazily from their
+    ``_addresses`` map on first send, so admission is a dictionary
+    update — the injector wrapper delegates attribute access to the
+    wrapped proxy, so this reaches the real map through it."""
+    if _sender_proxy is None:
+        return
+    addrs = getattr(_sender_proxy, "_addresses", None)
+    if isinstance(addrs, dict):
+        addrs[party] = address
+
+
+def forget_peer(party: str) -> None:
+    """Remove an evicted destination from the CURRENT sender proxy: drop
+    its address (new sends fail fast instead of dialing a corpse) and
+    close its per-destination worker if the transport keeps one."""
+    if _sender_proxy is None:
+        return
+    addrs = getattr(_sender_proxy, "_addresses", None)
+    if isinstance(addrs, dict):
+        addrs.pop(party, None)
+    workers = getattr(_sender_proxy, "_workers", None)
+    if isinstance(workers, dict):
+        worker = workers.pop(party, None)
+        if worker is not None:
+            try:
+                worker.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                logger.warning(
+                    "failed to close sender worker for evicted party %s",
+                    party, exc_info=True,
+                )
 
 
 def swap_sender_proxy(new_proxy) -> None:
@@ -283,6 +352,8 @@ def send(
     collides with it in normal operation — callers driving this function
     directly with that pair get a ``ValueError``."""
     _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id)
+    upstream_seq_id = _stamp_epoch(upstream_seq_id)
+    downstream_seq_id = _stamp_epoch(downstream_seq_id)
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         # Follower host of a multi-host party: the leader's identical
@@ -503,6 +574,8 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
     barrier (see ``send``); no payload ever arrives under it, so waiting
     on it is a ``ValueError``."""
     _reject_reserved_seq_ids(upstream_seq_id, curr_seq_id)
+    upstream_seq_id = _stamp_epoch(upstream_seq_id)
+    curr_seq_id = _stamp_epoch(curr_seq_id)
     ctx = get_global_context()
     if ctx is not None and not ctx.is_party_leader():
         relay = _party_relay_client()

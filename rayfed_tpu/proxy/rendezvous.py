@@ -27,13 +27,15 @@ from __future__ import annotations
 
 import logging
 import threading
+import weakref
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
 from rayfed_tpu._private.constants import (
+    CODE_FORBIDDEN,
     CODE_INTERNAL_ERROR,
     CODE_JOB_MISMATCH,
     CODE_OK,
@@ -45,6 +47,55 @@ logger = logging.getLogger(__name__)
 
 # decode_fn(header, payload) -> value
 DecodeFn = Callable[[Dict, memoryview], object]
+
+#: Seq-id prefix of membership control frames: dispatched to the job's
+#: registered control handler instead of being parked for a consumer
+#: (rayfed_tpu/membership/protocol.py).
+CONTROL_SEQ_PREFIX = "mbr:req:"
+
+# Per-job membership hooks (wired by MembershipManager.install):
+# control_handler(header, decoded_value) -> (code, message) serves
+# mbr:req:* frames on the coordinator party; roster_fn() -> set of
+# current roster parties lets the expire loop reap parked frames whose
+# source left the roster.
+_control_handlers: Dict[str, Callable] = {}
+_roster_fns: Dict[str, Callable[[], Set[str]]] = {}
+_hooks_lock = threading.Lock()
+
+# Every live store, so an epoch bump can purge an evicted party's
+# parked frames across all transports/jobs in this process.
+_stores: "weakref.WeakSet[RendezvousStore]" = weakref.WeakSet()
+
+
+def set_control_handler(job_name: str, handler: Callable) -> None:
+    with _hooks_lock:
+        _control_handlers[job_name] = handler
+
+
+def clear_control_handler(job_name: str) -> None:
+    with _hooks_lock:
+        _control_handlers.pop(job_name, None)
+
+
+def set_roster_fn(job_name: str, fn: Callable[[], Set[str]]) -> None:
+    with _hooks_lock:
+        _roster_fns[job_name] = fn
+
+
+def clear_roster_fn(job_name: str) -> None:
+    with _hooks_lock:
+        _roster_fns.pop(job_name, None)
+
+
+def evict_source_everywhere(job_name: str, party: str) -> int:
+    """Purge ``party``'s parked frames from every live store serving
+    ``job_name`` (the membership manager calls this when an epoch bump
+    evicts the party). Returns the number of entries evicted."""
+    n = 0
+    for store in list(_stores):
+        if store._job_name == job_name:
+            n += store.evict_source(party)
+    return n
 
 
 def default_decode(allowed_list, allow_pickle: bool = True, sharded_fn=None,
@@ -244,7 +295,7 @@ class RendezvousStore:
         # common case (consumer already parked in take()) resolves the
         # waiter one hop sooner.
         self._inline_decode_max = 64 * 1024
-        self._stats = {"receive_op_count": 0}
+        self._stats = {"receive_op_count": 0, "ghost_evicted": 0}
         # Readiness-ping bookkeeping (barrier mutuality): which peers
         # have pinged this receiver, by the header's src when the lane
         # carries one; pings on the reference-compatible gRPC wire have
@@ -253,6 +304,7 @@ class RendezvousStore:
         self._anon_pings = 0
         self._stopped = False
         self._deadlines: Dict[Tuple[str, str], float] = {}
+        _stores.add(self)
         if recv_timeout_s is not None:
             threading.Thread(
                 target=self._expire_loop,
@@ -263,7 +315,12 @@ class RendezvousStore:
     def _expire_loop(self) -> None:
         """Fail waiters whose deadline passed — a vanished peer cannot send
         an error envelope, so without this a pure receiver waits forever
-        (the reference behavior; opt-in via recv_timeout_in_ms)."""
+        (the reference behavior; opt-in via recv_timeout_in_ms). On
+        membership-enabled jobs, additionally reap parked frames whose
+        source party left the roster (epoch-stamped eviction): the eager
+        purge at the epoch bump catches frames already parked, this sweep
+        catches stragglers that land afterwards from a not-quite-dead
+        ghost process."""
         import time
 
         interval = max(0.05, min(1.0, self._recv_timeout_s / 4))
@@ -289,6 +346,21 @@ class RendezvousStore:
                         f"{self._recv_timeout_s}s (recv_timeout_in_ms)"
                     )
                 )
+            with _hooks_lock:
+                roster_fn = _roster_fns.get(self._job_name)
+            if roster_fn is not None:
+                try:
+                    roster = roster_fn()
+                except Exception:  # noqa: BLE001 - sweep is best-effort
+                    continue
+                with self._lock:
+                    ghosts = {
+                        h.get("src")
+                        for h, _ in self._arrived.values()
+                        if h.get("src") and h.get("src") not in roster
+                    }
+                for src in ghosts:
+                    self.evict_source(src)
 
     # -- transport side ----------------------------------------------------
 
@@ -342,6 +414,45 @@ class RendezvousStore:
                 CODE_PICKLE_FORBIDDEN,
                 "pickle payloads are disabled (allow_pickle_payloads=False)",
             )
+        if isinstance(key[0], str) and key[0].startswith(CONTROL_SEQ_PREFIX):
+            # Membership control frame: dispatched to the job's handler
+            # (coordinator party only), never parked — the handler's
+            # verdict rides back in this frame's ack, so a rejected join
+            # fails the sender's future with the 403 it earned.
+            with _hooks_lock:
+                handler = _control_handlers.get(job)
+            if handler is None:
+                return (
+                    CODE_FORBIDDEN,
+                    f"no membership coordinator at this party for {key[0]!r}",
+                )
+            try:
+                value = self._decode_fn(header, payload)
+            except BaseException:  # noqa: BLE001 - surfaced in the ack
+                logger.warning(
+                    "failed to decode membership control frame %s", key,
+                    exc_info=True,
+                )
+                return CODE_INTERNAL_ERROR, "undecodable control frame"
+            with self._lock:
+                self._stats["receive_op_count"] += 1
+            try:
+                code, msg = handler(header, value)
+            except Exception as e:  # noqa: BLE001 - surfaced in the ack
+                logger.warning(
+                    "membership control handler failed for %s", key,
+                    exc_info=True,
+                )
+                return CODE_INTERNAL_ERROR, f"control handler error: {e!r}"
+            if tracing.is_enabled():
+                import time
+
+                tracing.record(
+                    "membership", header.get("src", ""), header["up"],
+                    header["down"], nbytes, time.perf_counter(),
+                    ok=code == CODE_OK, event="control",
+                )
+            return code, msg
         with self._lock:
             self._stats["receive_op_count"] += 1
             if key in self._consumed:
@@ -416,6 +527,30 @@ class RendezvousStore:
             out.set_exception(e)
             return
         out.set_result(value)
+
+    def evict_source(self, party: str) -> int:
+        """Drop every parked (not-yet-consumed) frame whose ``src`` is
+        ``party`` — the ghost purge an epoch bump applies when a party is
+        evicted, so a rejoining replacement can never collide with its
+        pre-crash incarnation's frames. Evicted keys are tombstoned like
+        consumed ones (a straggling resend is acked-and-dropped), and the
+        count lands in ``get_stats()['ghost_evicted']``."""
+        with self._lock:
+            victims = [
+                key
+                for key, (header, _) in self._arrived.items()
+                if header.get("src") == party
+            ]
+            for key in victims:
+                self._arrived.pop(key, None)
+                self._mark_consumed(key)
+            self._stats["ghost_evicted"] += len(victims)
+        if victims:
+            logger.info(
+                "evicted %d parked frame(s) from departed party %r",
+                len(victims), party,
+            )
+        return len(victims)
 
     def get_stats(self) -> Dict:
         with self._lock:

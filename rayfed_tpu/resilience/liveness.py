@@ -118,6 +118,33 @@ class LivenessMonitor:
         self._issued_at: Dict[str, float] = {}
         self._stop_evt = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Fired exactly once per DEAD transition (the n == dead_after
+        # edge), from the tick thread. Elastic membership registers the
+        # coordinator's eviction intake here.
+        self._on_dead: Optional[Callable[[str], None]] = None
+
+    # -- peer set mutation (elastic membership) ------------------------
+    def set_on_dead(self, callback: Optional[Callable[[str], None]]) -> None:
+        self._on_dead = callback
+
+    def add_peer(self, party: str) -> None:
+        """Start monitoring ``party`` (admitted mid-run). The monitored
+        set is NOT frozen at start: parties added after ``start_monitor``
+        show up in ``view()`` and are probed from the next tick."""
+        with self._lock:
+            if party in self._misses:
+                return
+            self._misses[party] = 0
+            self._peers = sorted(set(self._peers) | {party})
+
+    def remove_peer(self, party: str) -> None:
+        """Stop monitoring ``party`` (left or evicted): its outstanding
+        probe is dropped and it vanishes from ``view()``."""
+        with self._lock:
+            self._misses.pop(party, None)
+            self._pending.pop(party, None)
+            self._issued_at.pop(party, None)
+            self._peers = [p for p in self._peers if p != party]
 
     # -- state machine (also driven directly by tests via tick()) ------
     def tick(self) -> None:
@@ -129,7 +156,9 @@ class LivenessMonitor:
             else self._config.interval_ms
         ) / 1000.0
         now = time.monotonic()
-        for p in self._peers:
+        for p in list(self._peers):
+            if p not in self._misses:  # removed since the snapshot
+                continue
             fut = self._pending.get(p)
             if fut is None:
                 self._issue(p)
@@ -152,6 +181,8 @@ class LivenessMonitor:
                 self._miss(p)
 
     def _issue(self, p: str) -> None:
+        if p not in self._misses:  # removed mid-tick
+            return
         try:
             self._pending[p] = self._probe_fn(p)
             self._issued_at[p] = time.monotonic()
@@ -161,6 +192,8 @@ class LivenessMonitor:
 
     def _hit(self, p: str) -> None:
         with self._lock:
+            if p not in self._misses:
+                return
             prev = self._misses[p]
             self._misses[p] = 0
         if prev >= self._config.suspect_after:
@@ -169,6 +202,8 @@ class LivenessMonitor:
 
     def _miss(self, p: str) -> None:
         with self._lock:
+            if p not in self._misses:
+                return
             self._misses[p] += 1
             n = self._misses[p]
         tracing.record("hb", p, "", "", 0, time.perf_counter(), ok=False)
@@ -177,6 +212,12 @@ class LivenessMonitor:
                 "party %s missed %d consecutive heartbeat(s): %s",
                 p, n, self._state_for(n),
             )
+        if n == self._config.dead_after and self._on_dead is not None:
+            try:
+                self._on_dead(p)
+            except Exception:  # noqa: BLE001 - callback must not kill ticks
+                logger.warning("liveness on-dead callback failed",
+                               exc_info=True)
 
     def _state_for(self, misses: int) -> str:
         if misses >= self._config.dead_after:

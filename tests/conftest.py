@@ -70,6 +70,8 @@ import pytest  # noqa: E402
 # sub-3-minute signal for matrix CI legs, the full suite runs on one leg
 # (VERDICT r2 weak #7). New tests default to fast until measured.
 _SLOW_TESTS = {
+    "test_churn_chaos_replace_dead_party",
+    "test_join_leave_lifecycle",
     "test_dryrun_multichip_under_driver_conditions",
     "test_federated_lora_round",
     "test_1f1b_loss_and_grads_match_gpipe",

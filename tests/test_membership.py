@@ -1,0 +1,753 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Elastic membership tests (docs/membership.md).
+
+Fast half: the view/epoch algebra, the coordinator's control intake and
+sync-point fold, the barrier layer's epoch seq-id stamp, topology
+re-planning over a bumped roster, ghost-offer rejection in the async
+plane, rendezvous ghost eviction, and mid-run liveness peer mutation —
+all driven in-process with fakes, no transport.
+
+Slow half: spawn-based lifecycle runs. ``test_join_leave_lifecycle``
+grows a 2-party job to 3 and shrinks it back via ``fed.join`` /
+``fed.leave``. ``test_churn_chaos_replace_dead_party`` is the ISSUE.md
+acceptance run: a 4-party FedAvg where one party is killed mid-round by
+an injected crash fault, gets evicted by the liveness monitor, and a
+replacement joins mid-training — training completes, every round
+aggregates at least one contributor (churn_rounds_lost == 0), and each
+round's aggregate equals the fixed-roster recomputation over the
+contributors that actually survived that round.
+"""
+
+import json
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import rayfed_tpu as fed
+from rayfed_tpu import topology as topo
+from rayfed_tpu._private.constants import CODE_FORBIDDEN, CODE_OK
+from rayfed_tpu.membership import (
+    MembershipConfig,
+    MembershipManager,
+    MembershipView,
+)
+from rayfed_tpu.membership import protocol
+from rayfed_tpu.membership.manager import set_membership_manager
+from rayfed_tpu.proxy import barriers, rendezvous
+from rayfed_tpu.resilience.liveness import (
+    ALIVE,
+    DEAD,
+    LivenessConfig,
+    LivenessMonitor,
+)
+from tests.utils import get_addresses, run_parties
+
+# ---------------------------------------------------------------------------
+# View / config algebra
+# ---------------------------------------------------------------------------
+
+
+def _view(parties, epoch=0):
+    addrs = {p: f"127.0.0.1:{9000 + i}" for i, p in enumerate(parties)}
+    return MembershipView(
+        epoch=epoch, roster=tuple(sorted(parties)), addresses=addrs
+    )
+
+
+def test_view_with_changes_bumps_epoch_only_on_change():
+    v = _view(["alice", "bob"])
+    same = v.with_changes({}, set())
+    assert same.epoch == 0 and same.roster == v.roster
+    # Removing a non-member is a no-op, not a bump.
+    assert v.with_changes({}, {"nobody"}).epoch == 0
+    grown = v.with_changes({"carol": "127.0.0.1:1"}, set())
+    assert grown.epoch == 1
+    assert grown.roster == ("alice", "bob", "carol")
+    assert grown.addresses["carol"] == "127.0.0.1:1"
+    shrunk = grown.with_changes({}, {"bob"})
+    assert shrunk.epoch == 2
+    assert shrunk.roster == ("alice", "carol")
+    assert "bob" not in shrunk.addresses
+    # Wire round-trip preserves everything.
+    back = MembershipView.from_wire(shrunk.to_wire())
+    assert back == shrunk
+
+
+def test_membership_config_rejects_unknown_keys():
+    cfg = MembershipConfig.from_dict(
+        {"coordinator": "alice", "auth_token": "t", "evict_dead": False}
+    )
+    assert cfg.coordinator == "alice" and not cfg.evict_dead
+    with pytest.raises(ValueError, match="unknown"):
+        MembershipConfig.from_dict({"coordinatr": "alice"})
+
+
+# ---------------------------------------------------------------------------
+# Epoch re-key: the barrier layer's seq-id stamp
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_stamp_rekeys_integer_seq_ids():
+    try:
+        barriers.set_seq_epoch_fn(lambda: 3)
+        assert barriers._stamp_epoch(7) == "e3:7"
+        assert barriers._stamp_epoch(0) == "e3:0"
+        # Strings (pings, membership control keys) pass through untouched.
+        assert barriers._stamp_epoch("ping") == "ping"
+        assert barriers._stamp_epoch("mbr:sync") == "mbr:sync"
+    finally:
+        barriers.clear_seq_epoch_fn()
+    # No hook (membership-free job): identity, zero behavior change.
+    assert barriers._stamp_epoch(7) == 7
+    # Hook returning None (no epoch yet): identity too.
+    try:
+        barriers.set_seq_epoch_fn(lambda: None)
+        assert barriers._stamp_epoch(7) == 7
+    finally:
+        barriers.clear_seq_epoch_fn()
+
+
+def test_manager_current_epoch_follows_view():
+    m = MembershipManager("j", "alice", _view(["alice", "bob"], epoch=4))
+    assert m.current_epoch() == 4
+    # The same function the barrier hook calls: a different seq-id space
+    # per epoch means an e4 frame can never collide with an e5 frame.
+    assert f"e{m.current_epoch()}:0" != "e5:0"
+
+
+# ---------------------------------------------------------------------------
+# Topology re-plan over a bumped roster
+# ---------------------------------------------------------------------------
+
+
+def test_manager_plan_matches_fresh_plan_over_roster():
+    parties = [f"p{i}" for i in range(6)]
+    m = MembershipManager("j", "p0", _view(parties))
+
+    def canon(plan):
+        return (
+            plan.parties,
+            plan.root,
+            [[(s.dst, tuple(s.srcs)) for s in lvl] for lvl in plan.levels],
+        )
+
+    for shape in ("flat", "tree", "ring"):
+        assert canon(m.plan(topology=shape)) == canon(
+            topo.plan(sorted(parties), shape)
+        ), shape
+    # After a bump that evicts p3 and admits p6, the manager's plan must
+    # equal a FRESH plan over the new roster — bit-for-bit the same
+    # schedule any fixed-roster driver would lay out. No hole, no stale
+    # slot where the evicted party used to reduce.
+    bumped = m.view().with_changes({"p6": "127.0.0.1:1"}, {"p3"})
+    m2 = MembershipManager("j", "p0", bumped)
+    survivors = sorted(set(parties) - {"p3"} | {"p6"})
+    for shape in ("flat", "tree", "ring"):
+        plan = m2.plan(topology=shape)
+        assert canon(plan) == canon(topo.plan(survivors, shape)), shape
+        assert not any(
+            "p3" in (s.dst, *s.srcs) for lvl in plan.levels for s in lvl
+        )
+
+
+# ---------------------------------------------------------------------------
+# Sync application + ghost tables
+# ---------------------------------------------------------------------------
+
+
+def _no_kv_store(monkeypatch):
+    # apply_sync_msg rewrites the KV cluster config; unit tests have no
+    # KV (no fed.init), so stub the seam out.
+    monkeypatch.setattr(
+        MembershipManager, "_store_addresses_locked", lambda self, a: None
+    )
+
+
+def test_apply_sync_bump_updates_roster_and_ghost_tables(monkeypatch):
+    _no_kv_store(monkeypatch)
+    m = MembershipManager("j", "alice", _view(["alice", "bob", "dave"]))
+    new_view = m.view().with_changes({"erin": "127.0.0.1:1"}, {"dave"})
+    msg = protocol.make_sync(
+        new_view.to_wire(), 5, {"erin": "127.0.0.1:1"}, {"dave": 1}
+    )
+    applied = m.apply_sync_msg(msg)
+    assert applied.epoch == 1
+    assert applied.roster == ("alice", "bob", "erin")
+    # dave is out at epoch 1: any offer from it is now a ghost.
+    assert m.is_ghost("dave", 0) and m.is_ghost("dave", 1)
+    # erin's admission epoch is 1: an epoch-0 stamp would be a frame from
+    # a pre-admission incarnation — ghost; epoch-1 (and None) are live.
+    assert m.is_ghost("erin", 0)
+    assert not m.is_ghost("erin", 1)
+    assert not m.is_ghost("erin", None)
+    assert not m.is_ghost("bob", 0)
+    # Re-applying the same epoch is idempotent; an older epoch is a bug.
+    assert m.apply_sync_msg(msg).epoch == 1
+    stale = protocol.make_sync(_view(["alice"], epoch=0).to_wire(), 6, {}, {})
+    with pytest.raises(RuntimeError, match="backwards"):
+        m.apply_sync_msg(stale)
+
+
+# ---------------------------------------------------------------------------
+# Coordinator intake + sync-point fold (the handshake, server side)
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_join_intake_and_auth():
+    m = MembershipManager(
+        "j", "alice", _view(["alice", "bob"]),
+        MembershipConfig(auth_token="s3cret"),
+    )
+    assert m.is_coordinator() and m.coordinator() == "alice"
+    coord = m.get_coordinator_state()
+    hdr = {"up": protocol.JOIN_REQ_SEQ, "src": "erin"}
+    code, _ = coord.handle_control(
+        hdr, protocol.make_join_request("erin", "127.0.0.1:1", "n1", "s3cret")
+    )
+    assert code == CODE_OK
+    assert coord.pending()["joins"] == ["erin"]
+    # Wrong token: 403 rides the request's ack and fails the joiner fast.
+    code, msg = coord.handle_control(
+        hdr, protocol.make_join_request("mallory", "127.0.0.1:2", "n2", "no")
+    )
+    assert code == CODE_FORBIDDEN and "token" in msg
+    assert coord.stats["joins_rejected"] == 1
+    # Malformed payloads never throw into the transport thread.
+    assert coord.handle_control(hdr, "garbage")[0] == CODE_FORBIDDEN
+    assert coord.handle_control({"up": "mbr:req:wat"}, {})[0] == CODE_FORBIDDEN
+    # Retransmitted request (same nonce): still one pending admission.
+    coord.handle_control(
+        hdr, protocol.make_join_request("erin", "127.0.0.1:1", "n1", "s3cret")
+    )
+    assert coord.pending()["joins"] == ["erin"]
+
+
+def test_coordinator_note_dead_queues_one_eviction():
+    m = MembershipManager("j", "alice", _view(["alice", "bob"]))
+    coord = m.get_coordinator_state()
+    coord.note_dead("bob")
+    coord.note_dead("bob")  # monitor re-verdicts are deduped
+    coord.note_dead("stranger")  # not in the roster: ignored
+    assert coord.pending()["evictions"] == ["bob"]
+
+
+def test_run_sync_folds_pending_and_emits_accept(monkeypatch):
+    _no_kv_store(monkeypatch)
+    sent = []
+    monkeypatch.setattr(
+        barriers, "send",
+        lambda dest, data, up, down: sent.append((dest, data, up, down)),
+    )
+    m = MembershipManager("j", "alice", _view(["alice", "bob", "dave"]))
+    coord = m.get_coordinator_state()
+    # No pending changes: a same-epoch broadcast to the roster minus self.
+    coord.run_sync(1)
+    assert m.current_epoch() == 0
+    assert sorted(s[0] for s in sent) == ["bob", "dave"]
+    assert all(s[2] == protocol.SYNC_SEQ and s[3] == "1" for s in sent)
+
+    sent.clear()
+    coord.handle_control(
+        {"up": protocol.JOIN_REQ_SEQ},
+        protocol.make_join_request("erin", "127.0.0.1:1", "n1", None),
+    )
+    coord.note_dead("dave")
+    applied = coord.run_sync(2)
+    assert applied.epoch == 1
+    assert applied.roster == ("alice", "bob", "erin")
+    # Broadcast goes to the OLD roster minus self minus the evicted;
+    # the joiner learns the view from its JoinAccept instead.
+    syncs = [s for s in sent if s[2] == protocol.SYNC_SEQ]
+    assert [s[0] for s in syncs] == ["bob"] and syncs[0][3] == "2"
+    accepts = [s for s in sent if s[2] == protocol.RESPONSE_SEQ]
+    assert [(s[0], s[3]) for s in accepts] == [("erin", "n1")]
+    accept = accepts[0][1]
+    assert accept["kind"] == "join-accept" and accept["sync_index"] == 2
+    assert MembershipView.from_wire(accept["view"]) == applied
+    assert accept["admissions"] == {"erin": 1}
+    assert accept["evictions"] == {"dave": 1}
+    assert coord.stats["epoch_bumps"] == 1
+    assert coord.pending() == {"joins": [], "leaves": [], "evictions": []}
+# ---------------------------------------------------------------------------
+# Ghost-offer rejection in the async plane
+# ---------------------------------------------------------------------------
+
+
+def test_buffered_aggregator_rejects_ghost_offers():
+    from rayfed_tpu.async_rounds import BufferedAggregator
+    from rayfed_tpu.config import AsyncAggregationConfig
+
+    m = MembershipManager(
+        "j", "alice", _view(["alice", "bob"], epoch=2),
+        admissions={"bob": 2},
+    )
+    agg = BufferedAggregator(AsyncAggregationConfig(buffer_k=10))
+    tree = {"w": np.ones((2,), np.float32)}
+    set_membership_manager(m)
+    try:
+        # Not in the roster at all: ghost regardless of stamp.
+        out = agg.offer("carol", tree, round_tag=0, epoch=2)
+        assert out == {
+            "accepted": False, "reason": "ghost", "staleness": 0,
+            "weight": 0.0, "buffered": 0, "version": 0,
+        }
+        # Stamped with an epoch predating bob's current incarnation: a
+        # pre-crash ghost of a since-rejoined party.
+        assert not agg.offer("bob", tree, round_tag=0, epoch=1)["accepted"]
+        assert agg.snapshot_stats()["dropped_ghost"] == 2
+        # Current incarnation (and membership-free None stamp): accepted.
+        assert agg.offer("bob", tree, round_tag=0, epoch=2)["accepted"]
+        assert agg.offer("bob", tree, round_tag=0, epoch=None)["accepted"]
+        assert agg.snapshot_stats()["dropped_ghost"] == 2
+    finally:
+        set_membership_manager(None)
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous: ghost eviction + control-frame dispatch
+# ---------------------------------------------------------------------------
+
+
+def _store():
+    return rendezvous.RendezvousStore("job", lambda header, payload: payload)
+
+
+def _hdr(src, up, down):
+    return {"job": "job", "src": src, "up": up, "down": down}
+
+
+def test_rendezvous_evicts_departed_partys_parked_frames():
+    store = _store()
+    try:
+        assert store.offer(_hdr("dave", "e0:1", "e0:1"), b"x")[0] == CODE_OK
+        assert store.offer(_hdr("dave", "e0:2", "e0:2"), b"y")[0] == CODE_OK
+        assert store.offer(_hdr("bob", "e0:1", "e0:3"), b"z")[0] == CODE_OK
+        assert store.evict_source("dave") == 2
+        assert store.get_stats()["ghost_evicted"] == 2
+        # Evicted keys are tombstoned: a straggling resend from the dead
+        # incarnation is acked-and-dropped, never re-parked — the
+        # replacement's identically-numbered frames can't collide (they
+        # carry a NEW epoch stamp anyway).
+        code, msg = store.offer(_hdr("dave", "e0:1", "e0:1"), b"x")
+        assert (code, msg) == (CODE_OK, "duplicate")
+        # The bystander's frame is untouched.
+        assert store.take("e0:1", "e0:3").result(timeout=1) == b"z"
+        assert store.evict_source("dave") == 0  # idempotent
+    finally:
+        store.shutdown()
+
+
+def test_rendezvous_dispatches_control_frames_to_handler():
+    store = _store()
+    try:
+        hdr = _hdr("erin", protocol.JOIN_REQ_SEQ, "n1")
+        # No coordinator registered at this party: 403 in the ack.
+        code, msg = store.offer(hdr, b"req")
+        assert code == CODE_FORBIDDEN and "coordinator" in msg
+        seen = []
+
+        def handler(header, value):
+            seen.append((header["src"], value))
+            return CODE_OK, "queued"
+
+        rendezvous.set_control_handler("job", handler)
+        try:
+            assert store.offer(hdr, b"req") == (CODE_OK, "queued")
+            assert seen == [("erin", b"req")]
+        finally:
+            rendezvous.clear_control_handler("job")
+        # Control frames are never parked for a consumer.
+        assert not store._arrived
+    finally:
+        store.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Liveness: mid-run peer mutation + DEAD escalation
+# ---------------------------------------------------------------------------
+
+
+def test_liveness_monitor_peers_mutable_and_on_dead_fires_once():
+    alive = {"bob": True, "erin": True}
+
+    def probe(p):
+        f = Future()
+        if alive[p]:
+            f.set_result(True)
+        else:
+            f.set_exception(ConnectionError("down"))
+        return f
+
+    dead_calls = []
+    mon = LivenessMonitor(
+        ["bob"],
+        LivenessConfig(interval_ms=10, suspect_after=1, dead_after=2),
+        probe_fn=probe,
+    )
+    mon.set_on_dead(dead_calls.append)
+    mon.tick()  # issue
+    mon.tick()  # ack
+    assert mon.view() == {"bob": ALIVE}
+    # Satellite: a party added AFTER the monitor started shows up in the
+    # view and is probed from the next tick — the set is not frozen.
+    mon.add_peer("erin")
+    assert mon.view() == {"bob": ALIVE, "erin": ALIVE}
+    mon.tick()
+    mon.tick()
+    assert mon.state("erin") == ALIVE
+    alive["erin"] = False
+    mon.tick()  # settles last good probe, reissues a failing one
+    mon.tick()  # miss 1
+    mon.tick()  # miss 2 -> DEAD, on_dead fires on the edge
+    assert mon.state("erin") == DEAD
+    assert dead_calls == ["erin"]
+    mon.tick()  # miss 3: NO second escalation
+    assert dead_calls == ["erin"]
+    # Eviction applied: the party vanishes from the view and its
+    # outstanding probe is dropped.
+    mon.remove_peer("erin")
+    assert mon.view() == {"bob": ALIVE}
+    mon.tick()
+    assert "erin" not in mon.view()
+    # add_peer is idempotent and a re-added party starts fresh.
+    mon.add_peer("bob")
+    assert mon.view() == {"bob": ALIVE}
+
+
+# ===========================================================================
+# Spawn-based lifecycle runs (slow)
+# ===========================================================================
+
+MBR_TOKEN = "mbr-test-token"
+MBR_BASES = {
+    "alice": 1.0, "bob": 2.0, "carol": 3.0, "dave": 4.0, "erin": 5.0,
+}
+
+
+def _fast_comm(extra=None):
+    cfg = {
+        "retry_policy": {
+            "max_attempts": 2,
+            "initial_backoff_ms": 50,
+            "max_backoff_ms": 100,
+        },
+        "timeout_in_ms": 2000,
+        "recv_timeout_in_ms": 2000,
+        "send_deadline_in_ms": 4000,
+    }
+    cfg.update(extra or {})
+    return cfg
+
+
+_LIVENESS = {
+    "interval_ms": 100, "suspect_after": 2, "dead_after": 4,
+    "timeout_ms": 300,
+}
+
+
+@fed.remote
+def _mbr_update(base, r):
+    return {"w": np.full((4,), base * (r + 1), dtype=np.float32)}
+
+
+def _expected_mean(contributors, r):
+    # Mirror of elastic_weighted_mean's float32 arithmetic: the updates
+    # are integer-valued float32 (exact partial sums), uniform weights,
+    # one float32 division at the end.
+    total = np.float32(sum(MBR_BASES[p] * (r + 1) for p in contributors))
+    return float(total / np.float32(len(contributors)))
+
+
+def _run_rounds(party, entry_round, total_rounds, skip_first_sync,
+                marker_dir, records):
+    """The shared per-round driver: membership sync at the top (the ONE
+    program point where the roster may change), contributions over the
+    view's roster, elastic aggregation over what survived."""
+    from rayfed_tpu.ops.aggregate import elastic_weighted_mean
+
+    for r in range(entry_round, total_rounds):
+        if skip_first_sync and r == entry_round:
+            # The joiner already holds the view of the sync that
+            # admitted it (docs/membership.md) — syncing again here
+            # would desynchronize the sync index with everyone else.
+            view = fed.membership_view()
+        else:
+            view = fed.membership_sync(timeout=30.0)
+        roster = sorted(view.roster)
+        objs = {p: _mbr_update.party(p).remote(MBR_BASES[p], r)
+                for p in roster}
+        got = fed.get([objs[p] for p in roster], timeout=3.0,
+                      on_missing="default")
+        contribs = dict(zip(roster, got))
+        live = fed.liveness_view()
+        agg = elastic_weighted_mean(contribs, liveness=live)
+        contributors = [
+            p for p in roster
+            if contribs[p] is not fed.MISSING and live.get(p) != DEAD
+        ]
+        assert party in contributors  # own update is local
+        np.testing.assert_allclose(
+            np.asarray(agg["w"]),
+            np.full((4,), _expected_mean(contributors, r), np.float32),
+        )
+        records.append({
+            "round": r,
+            "epoch": view.epoch,
+            "roster": roster,
+            "contributors": contributors,
+            "agg": float(np.asarray(agg["w"])[0]),
+        })
+        if marker_dir and party == "alice":
+            # Round beacon: the joiner process keys its fed.join() off
+            # these instead of wall-clock guesses.
+            with open(os.path.join(marker_dir, f"round-{r}"), "w"):
+                pass
+        time.sleep(0.25)
+
+
+def _wait_for_marker(marker_dir, r, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    path = os.path.join(marker_dir, f"round-{r}")
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"no round-{r} marker within {timeout}s")
+
+
+def _join_running_job(addresses, join_trigger_round, marker_dir):
+    """Block until the founders reach ``join_trigger_round``, then run
+    the fed.join handshake; returns this party's entry round."""
+    from rayfed_tpu.membership.manager import get_membership_manager
+
+    _wait_for_marker(marker_dir, join_trigger_round)
+    t0 = time.monotonic()
+    bootstrap = fed.join(
+        address=addresses["erin"],
+        party="erin",
+        coordinator="alice",
+        coordinator_address=addresses["alice"],
+        config={
+            "cross_silo_comm": _fast_comm(),
+            "resilience": {"liveness": dict(_LIVENESS)},
+            "membership": {
+                "auth_token": MBR_TOKEN,
+                "coordinator": "alice",
+                "sync_timeout_s": 30.0,
+            },
+        },
+        timeout=90.0,
+    )
+    join_ms = (time.monotonic() - t0) * 1e3
+    assert bootstrap is None  # no checkpoint/model-bank configured
+    manager = get_membership_manager()
+    view = fed.membership_view()
+    assert "erin" in view.roster and view.epoch >= 1
+    # Round r runs sync index r+1, and the accept's sync index is the
+    # sync that admitted us — so our entry round is that index minus 1.
+    entry_round = manager.sync_index() - 1
+    return entry_round, join_ms
+
+
+# ---------------------------------------------------------------------------
+# Join + leave lifecycle (no faults)
+# ---------------------------------------------------------------------------
+
+LIFE_ROUNDS = 10
+LIFE_JOIN_TRIGGER = 1  # erin dials in once the founders pass round 1
+
+
+def run_lifecycle_party(party, addresses, workdir):
+    founders = {p: a for p, a in addresses.items() if p != "erin"}
+    records = []
+    if party == "erin":
+        entry, _ = _join_running_job(addresses, LIFE_JOIN_TRIGGER, workdir)
+        # Participate for two rounds, then depart gracefully mid-training
+        # (fed.leave runs the intended shutdown itself).
+        leave_round = min(entry + 2, LIFE_ROUNDS - 2)
+        _run_rounds(party, entry, leave_round, True, None, records)
+        assert records, "joiner never completed a round"
+        fed.leave(timeout=30.0)
+        return
+    fed.init(
+        addresses=founders,
+        party=party,
+        config={
+            "barrier_on_initializing": True,
+            "cross_silo_comm": _fast_comm(),
+            "resilience": {"liveness": dict(_LIVENESS)},
+            "membership": {
+                "coordinator": "alice",
+                "auth_token": MBR_TOKEN,
+                "sync_timeout_s": 30.0,
+            },
+        },
+    )
+    _run_rounds(party, 0, LIFE_ROUNDS, False, workdir, records)
+    if party == "alice":
+        with open(os.path.join(workdir, "alice.json"), "w") as f:
+            json.dump(records, f, sort_keys=True)
+    fed.shutdown()
+
+
+def test_join_leave_lifecycle(tmp_path):
+    """A 2-party job grows to 3 when erin joins mid-training and shrinks
+    back when it leaves: both roster changes land as epoch bumps at sync
+    points, no round is lost, and every round's aggregate matches the
+    contributors the coordinator recorded for it."""
+    parties = ["alice", "bob", "erin"]
+    run_parties(
+        run_lifecycle_party, parties, timeout=180,
+        extra_args=(str(tmp_path),),
+        addresses=get_addresses(parties),
+    )
+    records = json.loads((tmp_path / "alice.json").read_text())
+    assert [rec["round"] for rec in records] == list(range(LIFE_ROUNDS))
+    assert all(rec["contributors"] for rec in records)  # no round lost
+    rosters = [set(rec["roster"]) for rec in records]
+    assert rosters[0] == {"alice", "bob"}
+    assert {"alice", "bob", "erin"} in rosters, "join bump never landed"
+    assert rosters[-1] == {"alice", "bob"}, "leave bump never landed"
+    assert records[-1]["epoch"] >= 2  # one bump in, one bump out
+    # Epochs only move forward, one sync at a time.
+    epochs = [rec["epoch"] for rec in records]
+    assert epochs == sorted(epochs)
+    for rec in records:
+        assert rec["agg"] == _expected_mean(
+            rec["contributors"], rec["round"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Churn chaos: crash + evict + replace, mid-training (the acceptance run)
+# ---------------------------------------------------------------------------
+
+CHURN_PARTIES = ["alice", "bob", "carol", "dave"]
+CHURN_ROUNDS = 12
+# dave pushes its update to 3 peers per 4-party round; after 9 data
+# sends the injector's permanent crash fires on the FIRST push of round
+# 3 — a mid-round kill, not a tidy boundary.
+CHURN_CRASH_ROUND = 3
+CHURN_CRASH_AFTER = 3 * CHURN_CRASH_ROUND
+CHURN_JOIN_TRIGGER = 4  # erin dials in while the eviction is in flight
+
+
+def run_churn_party(party, addresses, workdir):
+    founders = {p: a for p, a in addresses.items() if p != "erin"}
+    records = []
+    if party == "erin":
+        entry, join_ms = _join_running_job(
+            addresses, CHURN_JOIN_TRIGGER, workdir
+        )
+        _run_rounds(party, entry, CHURN_ROUNDS, True, None, records)
+        assert records, "replacement never completed a round"
+        with open(os.path.join(workdir, "erin.json"), "w") as f:
+            json.dump({"entry": entry, "join_ms": join_ms}, f)
+        fed.shutdown()
+        return
+    config = {
+        "barrier_on_initializing": True,
+        "cross_silo_comm": _fast_comm(
+            {"exit_on_sending_failure": True} if party == "dave" else None
+        ),
+        "resilience": {"liveness": dict(_LIVENESS)},
+        "membership": {
+            "coordinator": "alice",
+            "auth_token": MBR_TOKEN,
+            "evict_dead": True,
+            "sync_timeout_s": 30.0,
+        },
+    }
+    if party == "dave":
+        # The kill switch: dave's 10th data push raises a permanent
+        # InjectedFault, the unintended-shutdown path fires, and the
+        # handler turns it into a clean exit the parent can assert on.
+        config["resilience"]["fault_schedule"] = {
+            "seed": 7,
+            "rules": [{"fault": "crash", "src": "dave",
+                       "after": CHURN_CRASH_AFTER}],
+        }
+    fed.init(
+        addresses=founders,
+        party=party,
+        config=config,
+        sending_failure_handler=(
+            (lambda e: os._exit(0)) if party == "dave" else None
+        ),
+    )
+    try:
+        _run_rounds(party, 0, CHURN_ROUNDS, False, workdir, records)
+    except BaseException:
+        if party == "dave" and records and \
+                records[-1]["round"] >= CHURN_CRASH_ROUND - 1:
+            # Anything after the crash point is the expected death throes
+            # (evicted mid-sync, interrupted by the exit signal, ...).
+            os._exit(0)
+        raise
+    if party == "dave":
+        raise AssertionError("dave survived its own crash schedule")
+    if party == "alice":
+        with open(os.path.join(workdir, "alice.json"), "w") as f:
+            json.dump(records, f, sort_keys=True)
+    fed.shutdown()
+
+
+def test_churn_chaos_replace_dead_party(tmp_path):
+    """ISSUE.md acceptance: 4-party FedAvg; dave is killed mid-round by
+    an injected crash, the liveness monitor's DEAD verdict evicts it at
+    the next sync, and erin joins as its replacement mid-training.
+    Training completes on every surviving party, no round loses its
+    aggregate (churn_rounds_lost == 0), and each round's aggregate
+    equals the fixed-roster recomputation over that round's recorded
+    contributors."""
+    parties = CHURN_PARTIES + ["erin"]
+    run_parties(
+        run_churn_party, parties, timeout=200,
+        extra_args=(str(tmp_path),),
+        addresses=get_addresses(parties),
+    )
+    records = json.loads((tmp_path / "alice.json").read_text())
+    erin = json.loads((tmp_path / "erin.json").read_text())
+    assert [rec["round"] for rec in records] == list(range(CHURN_ROUNDS))
+    # The headline churn metric: every round aggregated something.
+    rounds_lost = sum(1 for rec in records if not rec["contributors"])
+    assert rounds_lost == 0
+    final = records[-1]
+    assert "dave" not in final["roster"], "dead party never evicted"
+    assert "erin" in final["roster"], "replacement never admitted"
+    assert "erin" in final["contributors"], "replacement never contributed"
+    assert final["epoch"] >= 1
+    assert 0 < erin["entry"] < CHURN_ROUNDS
+    # dave contributed before the crash and is gone from the roster (not
+    # merely MISSING) once the eviction bump lands.
+    assert "dave" in records[0]["contributors"]
+    evicted_at = min(
+        rec["round"] for rec in records if "dave" not in rec["roster"]
+    )
+    assert evicted_at > CHURN_CRASH_ROUND - 1
+    for rec in records[evicted_at:]:
+        assert "dave" not in rec["roster"]
+    # Aggregate correctness every round — including the degraded rounds
+    # between crash and eviction, and the grown-roster rounds after the
+    # join: the elastic mean equals the fixed-roster recomputation over
+    # exactly the contributors that survived that round.
+    for rec in records:
+        assert rec["agg"] == _expected_mean(
+            rec["contributors"], rec["round"]
+        )

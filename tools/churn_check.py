@@ -1,0 +1,140 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Elastic-membership churn gate (docs/membership.md).
+
+Runs bench.py's 5-party churn stage (spawned processes, real TCP
+transport): dave is crash-killed mid-round by an injected fault, the
+liveness monitor's DEAD verdict evicts it at the next membership sync,
+and erin joins as its replacement mid-training via ``fed.join``. FAILS
+LOUDLY — exit code 1 — when churn starts costing training rounds or the
+join path regresses. Wire this into CI so a change that quietly breaks
+the epoch bump (a sync that deadlocks on the dead party, a joiner that
+can't align its seq-id space, an eviction that never lands) turns the
+build red.
+
+Three gates:
+
+  rounds_lost — ``churn_rounds_lost`` must stay <= the budget
+                (default 0: churn must DEGRADE rounds — fewer
+                contributors — never lose them outright).
+  replaced    — the final roster must contain the joiner and not the
+                crashed party, and the joiner must have contributed to
+                the final round. A run where the eviction or admission
+                bump never lands fails here even if no round was lost.
+  join_ms     — ``churn_join_ms`` (fed.join() to the joiner's first
+                completed contribution round) must stay under budget.
+                Measured ~600-1500 ms on a quiet host (one sync-point
+                wait + one elastic round); the default 15s ceiling
+                catches the pathological regressions — a handshake that
+                waits out a liveness timeout, or a join serialized
+                behind a whole-job barrier.
+
+A total wall-clock budget bounds the whole check so a hang (a sync
+deadlocked on the dead party's slot) fails fast instead of eating the
+CI job timeout.
+
+Budgets:
+
+  FEDTPU_CHURN_BUDGET_JOIN_MS     default 15000 — join-to-first-round.
+  FEDTPU_CHURN_MAX_ROUNDS_LOST    default 0.
+  FEDTPU_CHURN_ROUNDS             default 12 training rounds.
+  FEDTPU_CHURN_WALL_BUDGET_S      default 300 — cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    join_budget_ms = float(
+        os.environ.get("FEDTPU_CHURN_BUDGET_JOIN_MS", "15000")
+    )
+    max_rounds_lost = int(os.environ.get("FEDTPU_CHURN_MAX_ROUNDS_LOST", "0"))
+    rounds = int(os.environ.get("FEDTPU_CHURN_ROUNDS", "12"))
+    wall_budget_s = float(os.environ.get("FEDTPU_CHURN_WALL_BUDGET_S", "300"))
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            bench._churn_party, "tcp", (rounds,),
+            timeout_s=wall_budget_s, parties=bench._CHURN5,
+        )
+    elapsed = time.monotonic() - t0
+    if elapsed > wall_budget_s:
+        print(
+            f"CHURN GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed exceeds "
+            f"the {wall_budget_s:.0f}s budget — a membership sync "
+            f"deadlocked on the dead party, not just a slow host.",
+            file=sys.stderr,
+        )
+        return 1
+
+    join_ms = res["churn_join_ms"]
+    lost = res["churn_rounds_lost"]
+    print(
+        f"join={join_ms:.0f}ms rounds_lost={lost}/{res['churn_rounds']} "
+        f"replaced={bool(res['churn_replaced'])} "
+        f"epoch={res['churn_epoch']} entry_round={res['churn_entry_round']} "
+        f"in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    failed = False
+    if lost > max_rounds_lost:
+        failed = True
+        print(
+            f"CHURN REGRESSION: {lost} round(s) aggregated ZERO "
+            f"contributors (budget {max_rounds_lost}). Churn must degrade "
+            f"rounds, never lose them: check that elastic aggregation "
+            f"still re-plans over the surviving roster and that the "
+            f"eviction bump lands at the sync point.",
+            file=sys.stderr,
+        )
+    if not res["churn_replaced"]:
+        failed = True
+        print(
+            "CHURN REGRESSION: the replacement never took over — the "
+            "final roster must contain the joiner (and not the crashed "
+            "party) with the joiner contributing to the final round. "
+            "Check the liveness DEAD -> eviction escalation and the "
+            "fed.join handshake's seq-epoch alignment.",
+            file=sys.stderr,
+        )
+    if join_ms > join_budget_ms:
+        failed = True
+        print(
+            f"CHURN REGRESSION: churn_join_ms {join_ms:.0f} is over the "
+            f"{join_budget_ms:.0f}ms budget — the handshake should cost "
+            f"one sync-point wait plus one round, not a liveness timeout "
+            f"or a whole-job barrier.",
+            file=sys.stderr,
+        )
+    if failed:
+        return 1
+    print(f"churn gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

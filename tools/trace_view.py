@@ -20,8 +20,9 @@ Usage::
 
 One row per (upstream, downstream) seq-id edge, time on the x axis over
 the artifact's full window. Timed spans (send / decode / task / fold /
-publish) render as bars, arrival events (recv) as single ticks, failed
-spans as ``x``. The point is hang forensics WITHOUT a debugger or a
+publish) render as bars, arrival events (recv) and membership events
+(join / evict / epoch-bump, glyph ``M`` — the epoch boundaries) as
+single ticks, failed spans as ``x``. The point is hang forensics WITHOUT a debugger or a
 Perfetto upload: the recurring gRPC-lane ``_fedavg_party`` wedge — and
 any async-mode straggler — shows up as the edge whose last mark sits far
 left of everyone else's.
@@ -45,6 +46,7 @@ _GLYPHS = {
     "fold": "F",
     "publish": "P",
     "hb": "h",
+    "membership": "M",
 }
 
 
