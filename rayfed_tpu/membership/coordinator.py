@@ -46,8 +46,11 @@ class MembershipCoordinator:
     def __init__(self, manager) -> None:
         self._manager = manager
         self._lock = threading.Lock()
-        # nonce -> join request dict (nonce-keyed so a retransmitted
-        # request — ack lost, sender retried — stays one admission).
+        # party -> join request dict (party-keyed so BOTH retry shapes
+        # collapse to one admission: a retransmit with the same nonce
+        # and a fresh-nonce retry after a timed-out handshake. The
+        # latest nonce wins — exactly one JoinAccept goes out, addressed
+        # to the request the joiner is still parked on).
         self._pending_joins: Dict[str, Dict] = {}
         self._pending_leaves: set = set()
         self._pending_evictions: set = set()
@@ -88,7 +91,7 @@ class MembershipCoordinator:
             )
             return CODE_FORBIDDEN, "membership auth token mismatch"
         with self._lock:
-            self._pending_joins[nonce] = {
+            self._pending_joins[party] = {
                 "party": party, "address": address, "nonce": nonce,
             }
         logger.info(
@@ -103,8 +106,11 @@ class MembershipCoordinator:
         if not party:
             return CODE_FORBIDDEN, "leave request missing party"
         with self._lock:
-            self._pending_leaves.add(party)
-            self.stats["leaves"] += 1
+            # A retransmitted leave (ack lost, sender retried) must not
+            # inflate the stat: count only the first insertion.
+            if party not in self._pending_leaves:
+                self._pending_leaves.add(party)
+                self.stats["leaves"] += 1
         logger.info(
             "membership: queued departure of %r (removed at next sync)",
             party,
@@ -154,23 +160,47 @@ class MembershipCoordinator:
 
         old_view = manager.view()
         # A party both joining and leaving/evicted in one window: the
-        # removal wins (its new incarnation can re-request); a removal
-        # of a non-member is a no-op.
-        remove = (leaves | evictions) & set(old_view.roster)
+        # explicit removal wins (its new incarnation can re-request); a
+        # removal of a non-member is a no-op.
+        remove_requested = (leaves | evictions) & set(old_view.roster)
         admitted = {
             j["party"]: j["address"]
             for j in joins
-            if j["party"] not in remove
+            if j["party"] not in remove_requested
         }
+        # A join whose name is ALREADY in the roster is a rejoin: the
+        # previous incarnation crashed and restarted before a liveness
+        # eviction caught up (impostors are the auth token's problem).
+        # Fold it as an implicit evict-then-admit — the epoch MUST bump
+        # even when the address is unchanged, so every member purges the
+        # pre-crash ghosts, cycles the connection, and the new admission
+        # epoch outdates the old incarnation's frames. The joiner itself
+        # gets the view from its JoinAccept, never the sync broadcast.
+        rejoining = set(admitted) & set(old_view.roster)
+        remove = remove_requested | rejoining
         accepted = [j for j in joins if j["party"] in admitted]
-        new_view = old_view.with_changes(admitted, remove)
+        new_view = old_view.with_changes(
+            admitted, remove_requested, force_bump=bool(rejoining)
+        )
         changed = new_view.epoch != old_view.epoch
         evicted_stamp = (
             {p: new_view.epoch for p in sorted(remove)} if changed else {}
         )
+        # Full post-bump ghost tables ride every sync: a member that
+        # missed an intermediate bump (recv timeout, lost frame) still
+        # reconciles to complete state, not just this bump's delta.
+        admissions_tbl, evictions_tbl = manager.ghost_tables()
+        if changed:
+            for p in remove:
+                evictions_tbl[p] = new_view.epoch
+                admissions_tbl.pop(p, None)
+            for p in admitted:
+                admissions_tbl[p] = new_view.epoch
+                evictions_tbl.pop(p, None)
         msg = protocol.make_sync(
             new_view.to_wire(), sync_index,
             admitted if changed else {}, evicted_stamp,
+            admissions_tbl, evictions_tbl,
         )
         # Broadcast to the OLD roster (minus self, minus the removed):
         # those parties are parked at the same sync point. Joiners learn
@@ -191,14 +221,13 @@ class MembershipCoordinator:
         # into our sender proxy by the apply, and the ghost tables the
         # accept carries include this very bump.
         if accepted:
-            admissions, evictions_tbl = manager.ghost_tables()
             bootstrap = manager.make_bootstrap()
             for j in accepted:
                 barriers.send(
                     j["party"],
                     protocol.make_join_accept(
                         applied.to_wire(), sync_index,
-                        admissions, evictions_tbl, bootstrap,
+                        admissions_tbl, evictions_tbl, bootstrap,
                     ),
                     protocol.RESPONSE_SEQ,
                     j["nonce"],

@@ -167,6 +167,15 @@ class MembershipManager:
         with self._lock:
             return dict(self._admissions), dict(self._evictions)
 
+    def eviction_table(self) -> Dict[str, int]:
+        """Snapshot of the eviction ghost table (party -> epoch as of
+        which it is out). The rendezvous expire loop sweeps parked
+        frames from exactly these sources — NOT from "anyone outside the
+        roster", which would reap a fresh joiner's early frames on a
+        member that has not applied the admitting sync yet."""
+        with self._lock:
+            return dict(self._evictions)
+
     def plan(self, topology: Optional[str] = None,
              group_size: Optional[int] = None):
         """The aggregation plan over the CURRENT roster — what
@@ -247,15 +256,13 @@ class MembershipManager:
 
     def install(self) -> None:
         """Register this manager's hooks with the rest of the engine:
-        the barrier layer's seq-epoch stamp, the rendezvous roster (for
-        ghost expiry), and — on the coordinator — the control-frame
-        handler and the liveness DEAD escalation."""
+        the barrier layer's seq-epoch stamp, the rendezvous eviction
+        table (for ghost expiry), and — on the coordinator — the
+        control-frame handler and the liveness DEAD escalation."""
         from rayfed_tpu.proxy import barriers, rendezvous
 
         barriers.set_seq_epoch_fn(self.current_epoch)
-        rendezvous.set_roster_fn(
-            self._job_name, lambda: set(self.roster())
-        )
+        rendezvous.set_evicted_fn(self._job_name, self.eviction_table)
         if self._coordinator is not None:
             rendezvous.set_control_handler(
                 self._job_name, self._coordinator.handle_control
@@ -270,7 +277,7 @@ class MembershipManager:
         from rayfed_tpu.proxy import barriers, rendezvous
 
         barriers.clear_seq_epoch_fn()
-        rendezvous.clear_roster_fn(self._job_name)
+        rendezvous.clear_evicted_fn(self._job_name)
         rendezvous.clear_control_handler(self._job_name)
         from rayfed_tpu.resilience import liveness
 
@@ -301,11 +308,22 @@ class MembershipManager:
             protocol.SYNC_SEQ,
             str(idx),
         )
-        msg = fut.result(
-            timeout=timeout
-            if timeout is not None
-            else self._config.sync_timeout_s
-        )
+        try:
+            msg = fut.result(
+                timeout=timeout
+                if timeout is not None
+                else self._config.sync_timeout_s
+            )
+        except BaseException:
+            # The sync did NOT land: roll the index back so a retry
+            # re-waits the SAME key (the coordinator's broadcast for it
+            # may still be in flight and will park). Without this, the
+            # index is consumed and the retry skips straight to the next
+            # sync's key, leaving this one permanently unapplied.
+            with self._lock:
+                if self._sync_index == idx:
+                    self._sync_index = idx - 1
+            raise
         return self.apply_sync_msg(msg)
 
     def apply_sync_msg(self, msg: Dict) -> MembershipView:
@@ -322,24 +340,43 @@ class MembershipManager:
                     f"membership sync went backwards: applied epoch "
                     f"{self._view.epoch}, received {new_view.epoch}"
                 )
-            return self._apply_bump_locked(new_view, admitted, evicted)
+            return self._apply_bump_locked(
+                new_view, admitted, evicted,
+                msg.get("admissions"), msg.get("evictions"),
+            )
 
     def _apply_bump_locked(
         self,
         new_view: MembershipView,
         admitted: Dict[str, str],
         evicted: Dict[str, int],
+        admissions: Optional[Dict[str, int]] = None,
+        evictions: Optional[Dict[str, int]] = None,
     ) -> MembershipView:
         """Install a successor view and apply its side effects. Caller
         holds the lock; the side effects below touch only module-level
-        seams (KV, proxies, monitor) that take their own locks."""
-        old_epoch = self._view.epoch
-        for p, e in evicted.items():
-            self._evictions[p] = int(e)
-            self._admissions.pop(p, None)
-        for p in admitted:
-            self._admissions[p] = new_view.epoch
-            self._evictions.pop(p, None)
+        seams (KV, proxies, monitor) that take their own locks.
+
+        ``admitted``/``evicted`` are THIS bump's delta (tracing, eager
+        ghost purge); ``admissions``/``evictions`` are the coordinator's
+        full post-bump ghost tables. The side effects reconcile the FULL
+        view, not the delta — the received epoch may be several bumps
+        ahead of ours (a sync recv timed out and a later one applied),
+        and a delta-only apply would leave intermediate joiners unknown
+        to the sender proxy and intermediate leavers undropped."""
+        old_view = self._view
+        old_epoch = old_view.epoch
+        if admissions is not None and evictions is not None:
+            # Self-contained sync: the tables replace ours wholesale.
+            self._admissions = {p: int(e) for p, e in admissions.items()}
+            self._evictions = {p: int(e) for p, e in evictions.items()}
+        else:
+            for p, e in evicted.items():
+                self._evictions[p] = int(e)
+                self._admissions.pop(p, None)
+            for p in admitted:
+                self._admissions[p] = new_view.epoch
+                self._evictions.pop(p, None)
         self._view = new_view
 
         from rayfed_tpu.proxy import barriers, rendezvous
@@ -350,22 +387,33 @@ class MembershipManager:
         from rayfed_tpu.resilience import liveness
 
         monitor = liveness.get_monitor()
-        for p, addr in admitted.items():
-            if p == self._self_party:
-                continue
-            barriers.admit_peer(p, addr)
-            if monitor is not None:
-                monitor.add_peer(p)
-        for p in evicted:
+        # Removal side effects FIRST, admissions second: a rejoining
+        # party appears in BOTH sets (implicit evict-then-admit) and has
+        # to come out the other side admitted — connection cycled, pre-
+        # crash parked frames purged. Beyond the delta, drop any peer
+        # that silently fell out of the roster across a missed bump.
+        stale = set(old_view.roster) - set(new_view.roster)
+        for p in sorted(set(evicted) | stale):
             if p == self._self_party:
                 continue
             barriers.forget_peer(p)
             if monitor is not None:
                 monitor.remove_peer(p)
-            # Purge the evicted party's parked frames NOW — the expire
-            # loop's roster sweep is the safety net for stores without
-            # one running.
+            # Purge the party's parked frames NOW. For a rejoiner this
+            # eager purge is the ONLY purge: once re-admitted it leaves
+            # the eviction table, so the expire-loop sweep no longer
+            # matches its old frames.
             rendezvous.evict_source_everywhere(self._job_name, p)
+        # Admissions reconcile the full roster: every roster address is
+        # (re-)taught to the sender proxy and the liveness monitor, both
+        # idempotent — so joiners admitted at a bump we never saw still
+        # get dialed.
+        for p, addr in new_view.addresses.items():
+            if p == self._self_party:
+                continue
+            barriers.admit_peer(p, addr)
+            if monitor is not None:
+                monitor.add_peer(p)
 
         # Re-key the seq-id space: the driver-side counter restarts at 0
         # and the barrier layer stamps the new epoch onto every integer

@@ -100,14 +100,22 @@ def make_sync(
     sync_index: int,
     admitted: Dict[str, str],
     evicted: Dict[str, int],
+    admissions: Optional[Dict[str, int]] = None,
+    evictions: Optional[Dict[str, int]] = None,
 ) -> Dict:
     """The per-sync view broadcast. ``admitted`` maps parties admitted at
     THIS bump to their addresses; ``evicted`` maps parties removed at
-    this bump to the epoch as of which they are out (ghost stamp)."""
+    this bump to the epoch as of which they are out (ghost stamp).
+    ``admissions``/``evictions`` are the coordinator's FULL ghost tables
+    after the bump — they make every sync self-contained, so a member
+    that missed an intermediate sync (recv timed out, frame lost) still
+    reconciles to the complete state instead of just this bump's delta."""
     return {
         "kind": "sync",
         "view": view_wire,
         "sync_index": int(sync_index),
         "admitted": dict(admitted),
         "evicted": dict(evicted),
+        "admissions": dict(admissions) if admissions is not None else None,
+        "evictions": dict(evictions) if evictions is not None else None,
     }

@@ -58,10 +58,16 @@ class MembershipView:
         self,
         add: Mapping[str, str] = (),
         remove: Iterable[str] = (),
+        *,
+        force_bump: bool = False,
     ) -> "MembershipView":
         """The successor view: ``add`` maps joining parties to their
         addresses, ``remove`` names leaving/evicted parties. Returns
-        ``self`` unchanged (same epoch) when nothing actually changes."""
+        ``self`` unchanged (same epoch) when nothing actually changes —
+        unless ``force_bump``, which bumps the epoch even for an
+        identical roster (a crashed party rejoining under its own name
+        at its old address must still re-key the seq-id space and purge
+        its pre-crash ghosts)."""
         add = dict(add)
         remove = set(remove)
         roster = (set(self.roster) - remove) | set(add)
@@ -69,8 +75,10 @@ class MembershipView:
             p: a for p, a in self.addresses.items() if p not in remove
         }
         addresses.update(add)
-        if tuple(sorted(roster)) == self.roster and addresses == dict(
-            self.addresses
+        if (
+            not force_bump
+            and tuple(sorted(roster)) == self.roster
+            and addresses == dict(self.addresses)
         ):
             return self
         return MembershipView(

@@ -30,7 +30,7 @@ import threading
 import weakref
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Callable, Dict, Optional, Set, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
@@ -55,11 +55,15 @@ CONTROL_SEQ_PREFIX = "mbr:req:"
 
 # Per-job membership hooks (wired by MembershipManager.install):
 # control_handler(header, decoded_value) -> (code, message) serves
-# mbr:req:* frames on the coordinator party; roster_fn() -> set of
-# current roster parties lets the expire loop reap parked frames whose
-# source left the roster.
+# mbr:req:* frames on the coordinator party; evicted_fn() -> the
+# membership eviction ghost table {party: eviction_epoch} lets the
+# expire loop reap parked frames from KNOWN-evicted sources. The sweep
+# is deliberately keyed off the eviction table rather than "not in the
+# roster": a fresh joiner may legitimately send before a slow member has
+# applied the admitting sync, and a roster-complement sweep would reap
+# (and tombstone) those frames, wedging the eventual recv.
 _control_handlers: Dict[str, Callable] = {}
-_roster_fns: Dict[str, Callable[[], Set[str]]] = {}
+_evicted_fns: Dict[str, Callable[[], Dict[str, int]]] = {}
 _hooks_lock = threading.Lock()
 
 # Every live store, so an epoch bump can purge an evicted party's
@@ -77,14 +81,24 @@ def clear_control_handler(job_name: str) -> None:
         _control_handlers.pop(job_name, None)
 
 
-def set_roster_fn(job_name: str, fn: Callable[[], Set[str]]) -> None:
+def set_evicted_fn(job_name: str, fn: Callable[[], Dict[str, int]]) -> None:
     with _hooks_lock:
-        _roster_fns[job_name] = fn
+        _evicted_fns[job_name] = fn
 
 
-def clear_roster_fn(job_name: str) -> None:
+def clear_evicted_fn(job_name: str) -> None:
     with _hooks_lock:
-        _roster_fns.pop(job_name, None)
+        _evicted_fns.pop(job_name, None)
+
+
+def _seq_epoch_of(seq_id) -> Optional[int]:
+    """The epoch stamp of an ``"e<epoch>:<n>"`` seq id, or None for
+    unstamped ids (pre-membership integers, string control keys)."""
+    if isinstance(seq_id, str) and seq_id.startswith("e"):
+        head, sep, _ = seq_id.partition(":")
+        if sep and head[1:].isdigit():
+            return int(head[1:])
+    return None
 
 
 def evict_source_everywhere(job_name: str, party: str) -> int:
@@ -316,11 +330,13 @@ class RendezvousStore:
         """Fail waiters whose deadline passed — a vanished peer cannot send
         an error envelope, so without this a pure receiver waits forever
         (the reference behavior; opt-in via recv_timeout_in_ms). On
-        membership-enabled jobs, additionally reap parked frames whose
-        source party left the roster (epoch-stamped eviction): the eager
-        purge at the epoch bump catches frames already parked, this sweep
+        membership-enabled jobs, additionally reap parked frames from
+        KNOWN-evicted sources (epoch-stamped eviction): the eager purge
+        at the epoch bump catches frames already parked, this sweep
         catches stragglers that land afterwards from a not-quite-dead
-        ghost process."""
+        ghost process. Only frames stamped with an epoch predating the
+        eviction (or unstamped) are reaped — a same-named replacement's
+        frames carry the newer admission epoch and survive."""
         import time
 
         interval = max(0.05, min(1.0, self._recv_timeout_s / 4))
@@ -347,20 +363,22 @@ class RendezvousStore:
                     )
                 )
             with _hooks_lock:
-                roster_fn = _roster_fns.get(self._job_name)
-            if roster_fn is not None:
+                evicted_fn = _evicted_fns.get(self._job_name)
+            if evicted_fn is not None:
                 try:
-                    roster = roster_fn()
+                    evicted = evicted_fn()
                 except Exception:  # noqa: BLE001 - sweep is best-effort
+                    continue
+                if not evicted:
                     continue
                 with self._lock:
                     ghosts = {
                         h.get("src")
                         for h, _ in self._arrived.values()
-                        if h.get("src") and h.get("src") not in roster
+                        if h.get("src") in evicted
                     }
                 for src in ghosts:
-                    self.evict_source(src)
+                    self.evict_source(src, before_epoch=evicted[src])
 
     # -- transport side ----------------------------------------------------
 
@@ -528,19 +546,29 @@ class RendezvousStore:
             return
         out.set_result(value)
 
-    def evict_source(self, party: str) -> int:
-        """Drop every parked (not-yet-consumed) frame whose ``src`` is
+    def evict_source(
+        self, party: str, before_epoch: Optional[int] = None
+    ) -> int:
+        """Drop parked (not-yet-consumed) frames whose ``src`` is
         ``party`` — the ghost purge an epoch bump applies when a party is
         evicted, so a rejoining replacement can never collide with its
-        pre-crash incarnation's frames. Evicted keys are tombstoned like
-        consumed ones (a straggling resend is acked-and-dropped), and the
-        count lands in ``get_stats()['ghost_evicted']``."""
+        pre-crash incarnation's frames. With ``before_epoch`` (the
+        party's eviction epoch, used by the expire-loop sweep) only
+        frames stamped with an OLDER epoch — or unstamped — are dropped;
+        frames carrying a newer stamp belong to a post-rejoin incarnation
+        and survive. Evicted keys are tombstoned like consumed ones (a
+        straggling resend is acked-and-dropped), and the count lands in
+        ``get_stats()['ghost_evicted']``."""
         with self._lock:
-            victims = [
-                key
-                for key, (header, _) in self._arrived.items()
-                if header.get("src") == party
-            ]
+            victims = []
+            for key, (header, _) in self._arrived.items():
+                if header.get("src") != party:
+                    continue
+                if before_epoch is not None:
+                    stamp = _seq_epoch_of(header.get("up"))
+                    if stamp is not None and stamp >= before_epoch:
+                        continue
+                victims.append(key)
             for key in victims:
                 self._arrived.pop(key, None)
                 self._mark_consumed(key)

@@ -238,6 +238,80 @@ def test_coordinator_join_intake_and_auth():
     assert coord.pending()["joins"] == ["erin"]
 
 
+def test_coordinator_join_retry_fresh_nonce_stays_one_admission(monkeypatch):
+    _no_kv_store(monkeypatch)
+    sent = []
+    monkeypatch.setattr(
+        barriers, "send",
+        lambda dest, data, up, down: sent.append((dest, data, up, down)),
+    )
+    m = MembershipManager("j", "alice", _view(["alice", "bob"]))
+    coord = m.get_coordinator_state()
+    hdr = {"up": protocol.JOIN_REQ_SEQ}
+    coord.handle_control(
+        hdr, protocol.make_join_request("erin", "127.0.0.1:1", "n1", None)
+    )
+    # The joiner timed out and retried with a FRESH nonce: still one
+    # pending admission, addressed to the nonce it is parked on NOW.
+    coord.handle_control(
+        hdr, protocol.make_join_request("erin", "127.0.0.1:1", "n2", None)
+    )
+    assert coord.pending()["joins"] == ["erin"]
+    coord.run_sync(1)
+    accepts = [s for s in sent if s[2] == protocol.RESPONSE_SEQ]
+    assert [(s[0], s[3]) for s in accepts] == [("erin", "n2")]
+    assert coord.stats["joins_accepted"] == 1
+
+
+def test_coordinator_leave_retransmit_counts_once():
+    m = MembershipManager("j", "alice", _view(["alice", "bob"]))
+    coord = m.get_coordinator_state()
+    hdr = {"up": protocol.LEAVE_REQ_SEQ}
+    req = protocol.make_leave_request("bob", "n1")
+    assert coord.handle_control(hdr, req)[0] == CODE_OK
+    assert coord.handle_control(hdr, req)[0] == CODE_OK  # ack-lost resend
+    assert coord.pending()["leaves"] == ["bob"]
+    assert coord.stats["leaves"] == 1
+
+
+def test_run_sync_rejoin_of_live_name_is_evict_then_admit(monkeypatch):
+    """A join whose party name is ALREADY in the roster — a crashed
+    party restarted before liveness eviction caught up — must land as an
+    implicit evict-then-admit: the epoch bumps even at an unchanged
+    address, so the pre-crash incarnation's frames become ghosts and the
+    joiner's fresh seq-0 space cannot collide with them."""
+    _no_kv_store(monkeypatch)
+    sent = []
+    monkeypatch.setattr(
+        barriers, "send",
+        lambda dest, data, up, down: sent.append((dest, data, up, down)),
+    )
+    m = MembershipManager("j", "alice", _view(["alice", "bob", "dave"]))
+    coord = m.get_coordinator_state()
+    addr = m.view().addresses["dave"]  # SAME address: the no-change trap
+    coord.handle_control(
+        {"up": protocol.JOIN_REQ_SEQ},
+        protocol.make_join_request("dave", addr, "n9", None),
+    )
+    applied = coord.run_sync(1)
+    assert applied.epoch == 1
+    assert applied.roster == ("alice", "bob", "dave")
+    # The rejoiner is excluded from the sync broadcast (its accept
+    # carries the view) and shows up in BOTH deltas of the message.
+    syncs = [s for s in sent if s[2] == protocol.SYNC_SEQ]
+    assert [s[0] for s in syncs] == ["bob"]
+    msg = syncs[0][1]
+    assert msg["admitted"] == {"dave": addr}
+    assert msg["evicted"] == {"dave": 1}
+    assert msg["admissions"]["dave"] == 1 and "dave" not in msg["evictions"]
+    accepts = [s for s in sent if s[2] == protocol.RESPONSE_SEQ]
+    assert [(s[0], s[3]) for s in accepts] == [("dave", "n9")]
+    # Pre-crash frames (epoch 0) are ghosts; the new incarnation is live.
+    assert m.is_ghost("dave", 0) and not m.is_ghost("dave", 1)
+    assert coord.stats["joins_accepted"] == 1
+    assert coord.stats["epoch_bumps"] == 1
+
+
 def test_coordinator_note_dead_queues_one_eviction():
     m = MembershipManager("j", "alice", _view(["alice", "bob"]))
     coord = m.get_coordinator_state()
@@ -284,6 +358,59 @@ def test_run_sync_folds_pending_and_emits_accept(monkeypatch):
     assert accept["evictions"] == {"dave": 1}
     assert coord.stats["epoch_bumps"] == 1
     assert coord.pending() == {"joins": [], "leaves": [], "evictions": []}
+def test_membership_sync_rolls_back_index_on_timeout(monkeypatch):
+    from concurrent.futures import TimeoutError as FuturesTimeout
+
+    m = MembershipManager("j", "bob", _view(["alice", "bob"]))
+    assert not m.is_coordinator()
+    monkeypatch.setattr(barriers, "recv", lambda *a: Future())  # never lands
+    with pytest.raises(FuturesTimeout):
+        m.membership_sync(timeout=0.05)
+    # The index rolled back: a retry re-waits the SAME sync key instead
+    # of permanently consuming it and skipping a bump.
+    assert m.sync_index() == 0
+    done = Future()
+    done.set_result(protocol.make_sync(m.view().to_wire(), 1, {}, {}))
+    keys = []
+
+    def recv(party, src, up, down):
+        keys.append((up, down))
+        return done
+
+    monkeypatch.setattr(barriers, "recv", recv)
+    applied = m.membership_sync(timeout=1.0)
+    assert keys == [(protocol.SYNC_SEQ, "1")]
+    assert m.sync_index() == 1 and applied.epoch == 0
+
+
+def test_apply_sync_reconciles_full_view_across_missed_bump(monkeypatch):
+    """A sync may arrive several epochs ahead of the local view (the
+    previous sync's recv failed). Applying it must reconcile the WHOLE
+    view — peers admitted at the missed bump still reach the sender
+    proxy, departed ones are still dropped — not just the final delta."""
+    _no_kv_store(monkeypatch)
+    admits, forgets = [], []
+    monkeypatch.setattr(
+        barriers, "admit_peer", lambda p, a: admits.append((p, a))
+    )
+    monkeypatch.setattr(barriers, "forget_peer", forgets.append)
+    m = MembershipManager("j", "alice", _view(["alice", "bob"]))
+    # Missed bump 1 admitted carol; bump 2 evicted bob. The received
+    # message carries only bump 2's delta, plus the full ghost tables.
+    final = _view(["alice", "carol"], epoch=2)
+    msg = protocol.make_sync(
+        final.to_wire(), 2, {}, {"bob": 2},
+        admissions={"alice": 0, "carol": 1}, evictions={"bob": 2},
+    )
+    applied = m.apply_sync_msg(msg)
+    assert applied.roster == ("alice", "carol")
+    assert admits == [("carol", final.addresses["carol"])]
+    assert forgets == ["bob"]
+    # Ghost tables were replaced wholesale from the sync's full tables.
+    assert m.is_ghost("carol", 0) and not m.is_ghost("carol", 1)
+    assert m.is_ghost("bob", 3)
+
+
 # ---------------------------------------------------------------------------
 # Ghost-offer rejection in the async plane
 # ---------------------------------------------------------------------------
@@ -350,6 +477,49 @@ def test_rendezvous_evicts_departed_partys_parked_frames():
         assert store.take("e0:1", "e0:3").result(timeout=1) == b"z"
         assert store.evict_source("dave") == 0  # idempotent
     finally:
+        store.shutdown()
+
+
+def test_evict_source_epoch_filter_spares_rejoined_incarnation():
+    store = _store()
+    try:
+        store.offer(_hdr("dave", "e1:1", "e1:1"), b"old")
+        store.offer(_hdr("dave", "e2:1", "e2:1"), b"new")
+        store.offer(_hdr("dave", "mbr:rsp", "n1"), b"unstamped")
+        # Eviction epoch 2: pre-eviction stamps and unstamped keys go;
+        # the rejoined incarnation's e2 frame survives.
+        assert store.evict_source("dave", before_epoch=2) == 2
+        assert store.take("e2:1", "e2:1").result(timeout=1) == b"new"
+    finally:
+        store.shutdown()
+
+
+def test_expire_sweep_reaps_only_known_evicted_sources():
+    """The expire-loop sweep keys off the membership EVICTION table, not
+    'src outside the roster': a fresh joiner's early frames (sent before
+    this member applied the admitting sync) must park untouched, and a
+    rejoined incarnation's post-eviction frames must survive too."""
+    store = rendezvous.RendezvousStore(
+        "job", lambda header, payload: payload, recv_timeout_s=0.4
+    )
+    try:
+        rendezvous.set_evicted_fn("job", lambda: {"dave": 2})
+        store.offer(_hdr("dave", "e1:1", "e1:1"), b"pre-crash")
+        store.offer(_hdr("dave", "e2:1", "e2:1"), b"rejoined")
+        store.offer(_hdr("erin", "e2:2", "e2:2"), b"joiner")
+        deadline = time.monotonic() + 5
+        while (
+            time.monotonic() < deadline
+            and store.get_stats()["ghost_evicted"] < 1
+        ):
+            time.sleep(0.05)
+        assert store.get_stats()["ghost_evicted"] == 1
+        # The reaped key is tombstoned; the survivors are deliverable.
+        assert store.offer(_hdr("dave", "e1:1", "e1:1"), b"x")[1] == "duplicate"
+        assert store.take("e2:1", "e2:1").result(timeout=1) == b"rejoined"
+        assert store.take("e2:2", "e2:2").result(timeout=1) == b"joiner"
+    finally:
+        rendezvous.clear_evicted_fn("job")
         store.shutdown()
 
 
