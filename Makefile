@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma serve async churn clean
+.PHONY: native test lint chaos latency scale dma serve async churn obs clean
 
 native:
 	python setup.py build_ext --inplace
@@ -79,6 +79,17 @@ async:
 churn:
 	JAX_PLATFORMS=cpu python tools/churn_check.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_membership.py -q
+
+# Observability gate (docs/observability.md): a 3-party round with the
+# telemetry plane on, paired against telemetry-off windows —
+# metrics_overhead_pct must stay under FEDTPU_OBS_BUDGET_PCT (default
+# 3%), every core series must appear in the collector's /metrics
+# scrape, all parties must report in /fleet, and at least one seq-id
+# edge in /trace must stitch spans from two parties. Mirrors the `obs`
+# job in .github/workflows/tests.yml.
+obs:
+	JAX_PLATFORMS=cpu python tools/obs_check.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -1723,6 +1723,145 @@ def _churn_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_OBS3 = ("alice", "bob", "carol")
+
+
+def _obs_party(party, addresses, transport, result_path, rounds):
+    """3-party telemetry-plane stage (docs/observability.md): paired
+    telemetry-off / telemetry-on windows of the same tiny-aggregate
+    round, toggled at identical program points on every party, measure
+    what the metrics registry + agent pushes cost the training loop —
+    ``metrics_overhead_pct`` is the median over the pairs, so a host
+    regime shift poisons one pair, not the headline. A final
+    telemetry-on window lets alice (the collector) scrape its own HTTP
+    endpoint: ``fleet_scrape_ms``, the core-series roll call, and the
+    cross-party stitched-trace check that tools/obs_check.py gates."""
+    import statistics
+    import urllib.request
+
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import telemetry
+    from rayfed_tpu.federated import fed_aggregate
+    from rayfed_tpu.telemetry.config import TelemetryConfig
+
+    job = f"bench-obs-{transport}"
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={"cross_silo_comm": dict(_FAST_RETRY), "transport": transport},
+        job_name=job,
+        logging_level="error",
+    )
+
+    @fed.remote
+    def contrib(seed, r):
+        rng = np.random.default_rng(seed + r)
+        return {"w": rng.standard_normal(2048).astype(np.float32)}
+
+    @fed.remote
+    def barrier(x):
+        return True
+
+    seeds = {p: i for i, p in enumerate(_OBS3)}
+
+    def window(n):
+        # Median per-round ms, not window mean: one GC pause or
+        # scheduler hiccup in a 100ms window would otherwise swamp the
+        # few-percent effect this stage exists to measure.
+        times = []
+        for r in range(n):
+            t0 = time.perf_counter()
+            objs = {
+                p: contrib.party(p).remote(seeds[p], r) for p in _OBS3
+            }
+            agg = fed_aggregate(objs, op="mean")
+            fed.get(barrier.party("alice").remote(agg))
+            times.append((time.perf_counter() - t0) * 1000.0)
+        return statistics.median(times)
+
+    cfg = TelemetryConfig(
+        collector="alice", push_interval_ms=250, http_port=0
+    )
+
+    _progress(party, "warmup")
+    window(max(2, rounds // 4))
+
+    # 5 pairs, order alternating OFF-first / ON-first: a monotone host
+    # drift (load ramping up or down across the stage) then biases half
+    # the pairs each way and the median cancels it, instead of every
+    # pair charging the drift to the on-window.
+    off_ms, on_ms = [], []
+    for i in range(5):
+        _progress(party, f"pair {i}")
+
+        def on_window():
+            telemetry.start(job, party, dict(addresses), cfg)
+            ms = window(rounds)
+            telemetry.stop()
+            return ms
+
+        if i % 2 == 0:
+            off_ms.append(window(rounds))
+            on_ms.append(on_window())
+        else:
+            on_ms.append(on_window())
+            off_ms.append(window(rounds))
+
+    # Scrape window: telemetry back on, a short burst of rounds, then a
+    # couple of push intervals of settle time so every party's delta
+    # lands before the collector is read.
+    _progress(party, "scrape window")
+    telemetry.start(job, party, dict(addresses), cfg)
+    window(max(2, rounds // 4))
+    time.sleep(1.0)
+
+    if party == "alice":
+        core = [
+            "fed_transport_send_ops_total",
+            "fed_transport_recv_ops_total",
+            "fed_transport_inline_sends_total",
+            "fed_telemetry_pushes_total",
+            "fed_telemetry_party_stale",
+            "fed_telemetry_fleet_epoch",
+            "fed_driver_aggregates_total",
+        ]
+        url = telemetry.http_url()
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(url + "/fleet", timeout=10) as resp:
+            fleet = json.loads(resp.read().decode("utf-8"))
+        fleet_scrape_ms = (time.perf_counter() - t0) * 1000.0
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as resp:
+            text = resp.read().decode("utf-8")
+        lines = text.splitlines()
+        missing = [
+            n for n in core
+            if not any(ln.startswith(n) for ln in lines)
+        ]
+        with urllib.request.urlopen(url + "/trace", timeout=10) as resp:
+            trace = json.loads(resp.read().decode("utf-8"))
+        stitched = any(
+            len({ev["party"] for ev in e["events"]}) >= 2
+            for e in trace.get("edges", [])
+        )
+        overhead = statistics.median(
+            (on - off) / off * 100.0 for off, on in zip(off_ms, on_ms)
+        )
+        with open(result_path, "w") as f:
+            json.dump({
+                "metrics_overhead_pct": overhead,
+                "fleet_scrape_ms": fleet_scrape_ms,
+                "obs_off_ms": off_ms,
+                "obs_on_ms": on_ms,
+                "obs_series_missing": missing,
+                "obs_stitched": int(stitched),
+                "obs_parties_reporting": len(fleet.get("parties", {})),
+            }, f)
+    telemetry.stop()
+    fed.shutdown()
+
+
 def _try_build_fastwire() -> None:
     """Best-effort build of the native C++ IO lane; the transport falls
     back to pure-Python sockets if this fails."""
@@ -1970,6 +2109,18 @@ def main() -> None:
             "churn_epoch": "churn_epoch",
             "churn_entry_round": "churn_entry_round",
             "churn_rounds": "churn_rounds",
+        },
+    ))
+    # Telemetry plane (docs/observability.md): paired on/off windows
+    # price the metrics registry + agent pushes; tools/obs_check.py
+    # gates the overhead and the collector's fleet/trace endpoints.
+    result.update(_bench_stage(
+        _obs_party, "metrics_overhead_pct", "FEDTPU_BENCH_OBS_ROUNDS", 60,
+        [("tcp", "metrics_overhead_pct")], cpu_force=True, parties=_OBS3,
+        timeout_s=420,
+        extra_fields={
+            "fleet_scrape_ms": "fleet_scrape_ms",
+            "obs_stitched": "obs_stitched",
         },
     ))
     # N-party scale sweep (in-process simulated parties, real wire edges).

@@ -53,6 +53,10 @@ from rayfed_tpu.async_rounds import (  # noqa: F401  (after api import)
     AsyncRoundHandle,
     async_round,
 )
+from rayfed_tpu.telemetry import (  # noqa: F401
+    export_fleet_trace,
+    telemetry_snapshot,
+)
 
 __version__ = "0.1.0"
 
@@ -80,5 +84,7 @@ __all__ = [
     "ServeHandle",
     "async_round",
     "AsyncRoundHandle",
+    "telemetry_snapshot",
+    "export_fleet_trace",
     "__version__",
 ]

@@ -385,6 +385,23 @@ def init(
         membership_manager.install()
         set_membership_manager(membership_manager)
 
+    # Telemetry plane (docs/observability.md): per-party metrics agent +
+    # the collector/HTTP endpoint at the collector party. AFTER the
+    # membership block so the collector's fleet view can consult the
+    # installed manager from its first scrape. Leader-only, like the
+    # proxies the agent pushes through.
+    telemetry_dict = config.get("telemetry")
+    if telemetry_dict is not None and party_process_id == 0:
+        from rayfed_tpu import telemetry as _telemetry
+        from rayfed_tpu.telemetry.config import TelemetryConfig
+
+        _telemetry.start(
+            job_name,
+            party,
+            dict(addresses),
+            TelemetryConfig.from_dict(telemetry_dict),
+        )
+
     if config.get("barrier_on_initializing", False) and party_process_id == 0:
         barriers.ping_others(addresses=addresses, self_party=party, max_retries=3600)
 
@@ -428,6 +445,16 @@ def _shutdown(intended: bool = True):
             failure_handler(last_sending_error)
         exit_on_sending_failure = ctx.get_exit_on_sending_failure()
 
+    # Telemetry stops first of all, while the proxies are still up: the
+    # agent's final flush rides the inline lane, and the collector's
+    # control handler unregisters before the rendezvous store goes away.
+    # No-op when init never started it.
+    _telemetry = sys.modules.get("rayfed_tpu.telemetry")
+    if _telemetry is not None:
+        try:
+            _telemetry.stop(flush=intended)
+        except Exception:  # noqa: BLE001 - telemetry must not block teardown
+            logger.warning("telemetry shutdown failed", exc_info=True)
     # Resilience teardown FIRST — before the send drain and long before
     # the proxies go away: a heartbeat tick landing mid-teardown would
     # count misses against peers that are merely shutting down too (and

@@ -68,6 +68,7 @@ from rayfed_tpu import api as fed
 from rayfed_tpu import tracing
 from rayfed_tpu.config import AsyncAggregationConfig
 from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -194,6 +195,57 @@ class BufferedAggregator:
             "publishes": 0,
             "publish_errors": 0,
         }
+        # Mirror every stats bump into the process-global telemetry
+        # registry so the fleet view sees aggregator health without
+        # polling snapshot_stats() (docs/observability.md).
+        _reg = telemetry_metrics.get_registry()
+        _offers = _reg.counter(
+            "fed_async_offers_total",
+            "Buffered-aggregator offers by outcome.",
+            labels=("session", "result"),
+        )
+        self._m_offers = {
+            k: _offers.labels(session=session, result=k)
+            for k in ("accepted", "dropped_dead", "dropped_ghost",
+                      "dropped_stale")
+        }
+        self._m_publishes = _reg.counter(
+            "fed_async_publishes_total", "K-publishes folded and installed.",
+            labels=("session",),
+        ).labels(session=session)
+        self._m_publish_errors = _reg.counter(
+            "fed_async_publish_errors_total",
+            "Publish hooks that raised (aggregation itself unaffected).",
+            labels=("session",),
+        ).labels(session=session)
+        self._m_depth = _reg.gauge(
+            "fed_async_buffer_depth", "Contributions currently buffered.",
+            labels=("session",),
+        ).labels(session=session)
+        self._m_version = _reg.gauge(
+            "fed_async_version", "Published model version.",
+            labels=("session",),
+        ).labels(session=session)
+        self._m_latest_tag = _reg.gauge(
+            "fed_async_latest_round_tag",
+            "Newest round tag seen across offers.",
+            labels=("session",),
+        ).labels(session=session)
+
+    def _bump_stat_locked(self, key: str) -> None:
+        self.stats[key] += 1
+        m = self._m_offers.get(key)
+        if m is not None:
+            m.inc()
+        elif key == "publishes":
+            self._m_publishes.inc()
+        elif key == "publish_errors":
+            self._m_publish_errors.inc()
+
+    def _sync_gauges_locked(self) -> None:
+        self._m_depth.set(len(self._buffer))
+        self._m_version.set(self.version)
+        self._m_latest_tag.set(self._latest_tag)
 
     # -- queries ------------------------------------------------------------
 
@@ -246,16 +298,17 @@ class BufferedAggregator:
         tree = _snapshot_tree(tree)
         with self._lock:
             self._latest_tag = max(self._latest_tag, int(round_tag))
+            self._m_latest_tag.set(self._latest_tag)
             staleness = self._latest_tag - int(round_tag)
             if membership is not None and membership.is_ghost(party, epoch):
-                self.stats["dropped_ghost"] += 1
+                self._bump_stat_locked("dropped_ghost")
                 return {
                     "accepted": False, "reason": "ghost",
                     "staleness": staleness, "weight": 0.0,
                     "buffered": len(self._buffer), "version": self.version,
                 }
             if state == DEAD:
-                self.stats["dropped_dead"] += 1
+                self._bump_stat_locked("dropped_dead")
                 return {
                     "accepted": False, "reason": "dead",
                     "staleness": staleness, "weight": 0.0,
@@ -265,7 +318,7 @@ class BufferedAggregator:
                 self.cfg.max_staleness is not None
                 and staleness > self.cfg.max_staleness
             ):
-                self.stats["dropped_stale"] += 1
+                self._bump_stat_locked("dropped_stale")
                 return {
                     "accepted": False, "reason": "stale",
                     "staleness": staleness, "weight": 0.0,
@@ -282,10 +335,11 @@ class BufferedAggregator:
                 _Contribution(slot, party, int(round_tag), staleness,
                               tree, eff)
             )
-            self.stats["accepted"] += 1
+            self._bump_stat_locked("accepted")
             published = None
             if len(self._buffer) >= self.cfg.buffer_k:
                 published = self._fold_and_publish_locked(t0)
+            self._sync_gauges_locked()
             return {
                 "accepted": True, "staleness": staleness, "weight": eff,
                 "buffered": len(self._buffer), "version": self.version,
@@ -326,7 +380,7 @@ class BufferedAggregator:
             path = "fold"
         self._current = tree_mix(self._current, mean, self.cfg.server_lr)
         self.version += 1
-        self.stats["publishes"] += 1
+        self._bump_stat_locked("publishes")
         tracing.record(
             "fold", "", f"async:{self.session}", f"v{self.version}",
             0, t0,
@@ -343,7 +397,7 @@ class BufferedAggregator:
                 )
             except Exception as e:  # noqa: BLE001 - a failed downstream
                 # publish must not poison the aggregation itself
-                self.stats["publish_errors"] += 1
+                self._bump_stat_locked("publish_errors")
                 tracing.record(
                     "publish", "", f"async:{self.session}",
                     f"v{self.version}", 0, tp, ok=False,

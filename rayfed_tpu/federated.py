@@ -37,6 +37,13 @@ from typing import Any, Dict, Optional, Sequence
 
 import rayfed_tpu as fed
 from rayfed_tpu import topology as topo
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
+
+_m_aggregates = telemetry_metrics.get_registry().counter(
+    "fed_driver_aggregates_total",
+    "fed_aggregate calls laid out by this driver, by mode.",
+    labels=("mode",),
+)
 
 
 @fed.remote
@@ -171,6 +178,8 @@ def fed_aggregate(
         for the next round.
     """
     assert objs, "need at least one party's object"
+    if mode in ("sync", "async"):
+        _m_aggregates.labels(mode=mode).inc()
     if mode == "async":
         if op not in ("mean", "wmean"):
             raise ValueError(

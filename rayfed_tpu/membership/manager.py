@@ -56,8 +56,18 @@ from rayfed_tpu._private.global_context import get_global_context
 from rayfed_tpu.membership import protocol
 from rayfed_tpu.membership.config import MembershipConfig
 from rayfed_tpu.membership.view import MembershipView
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
+
+_m_epoch = telemetry_metrics.get_registry().gauge(
+    "fed_membership_epoch",
+    "This party's applied membership epoch.",
+)
+_m_roster_size = telemetry_metrics.get_registry().gauge(
+    "fed_membership_roster_size",
+    "Parties in this party's applied roster.",
+)
 
 
 def resolve_coordinator(config: MembershipConfig, roster) -> str:
@@ -89,6 +99,8 @@ class MembershipManager:
         self._config = config or MembershipConfig()
         self._lock = threading.RLock()
         self._view = view
+        _m_epoch.set(view.epoch)
+        _m_roster_size.set(len(view.roster))
         self._sync_index = int(sync_index)
         # Ghost tables. A party's ADMISSION epoch is the epoch of the
         # bump that added it (0 for the initial roster); its EVICTION
@@ -378,6 +390,8 @@ class MembershipManager:
                 self._admissions[p] = new_view.epoch
                 self._evictions.pop(p, None)
         self._view = new_view
+        _m_epoch.set(new_view.epoch)
+        _m_roster_size.set(len(new_view.roster))
 
         from rayfed_tpu.proxy import barriers, rendezvous
 

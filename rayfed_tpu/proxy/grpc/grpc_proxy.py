@@ -54,6 +54,7 @@ from rayfed_tpu.proxy.base import ReceiverProxy, SenderProxy
 from rayfed_tpu.proxy.grpc import fedproto
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
 from rayfed_tpu.resilience.retry import grpc_retry_policy
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -109,6 +110,14 @@ class GrpcSenderProxy(SenderProxy):
         self._pool = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="fedtpu-grpc-send"
         )
+        # Send ops mirror into the process-global registry; get_stats()
+        # counts from the local dict so co-located proxies sharing the
+        # series stay per-instance (rayfed_tpu/telemetry/metrics.py).
+        self._m_send_ops = telemetry_metrics.get_registry().counter(
+            "fed_transport_send_ops_total",
+            "Data frames handed to the wire, by transport.",
+            labels=("transport",),
+        ).labels(transport="grpc")
         self._stats_lock = threading.Lock()
         self._stats = {"send_op_count": 0}
 
@@ -116,7 +125,8 @@ class GrpcSenderProxy(SenderProxy):
         pass
 
     def get_stats(self) -> Dict:
-        return dict(self._stats)
+        with self._stats_lock:
+            return dict(self._stats)
 
     def stop(self) -> None:
         for ch in self._channels.values():
@@ -229,6 +239,7 @@ class GrpcSenderProxy(SenderProxy):
             )
         with self._stats_lock:
             self._stats["send_op_count"] += 1
+        self._m_send_ops.inc()
         if ok:
             return True
         logger.warning(

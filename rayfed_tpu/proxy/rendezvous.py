@@ -34,6 +34,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from rayfed_tpu import tracing
 from rayfed_tpu._private import serialization
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 from rayfed_tpu._private.constants import (
     CODE_FORBIDDEN,
     CODE_INTERNAL_ERROR,
@@ -48,21 +49,29 @@ logger = logging.getLogger(__name__)
 # decode_fn(header, payload) -> value
 DecodeFn = Callable[[Dict, memoryview], object]
 
-#: Seq-id prefix of membership control frames: dispatched to the job's
-#: registered control handler instead of being parked for a consumer
-#: (rayfed_tpu/membership/protocol.py).
-CONTROL_SEQ_PREFIX = "mbr:req:"
+#: Reserved control seq-id namespaces. A string upstream seq id starting
+#: with one of these is NEVER parked for a consumer: it is dispatched to
+#: the handler registered for its (job, prefix), or rejected with
+#: ``CODE_FORBIDDEN`` when this party has none — a join request sent to
+#: a non-coordinator and a telemetry push sent to a non-collector both
+#: earn the same explicit refusal instead of wedging in ``_arrived``.
+CONTROL_SEQ_PREFIX = "mbr:req:"    # membership control (membership/protocol.py)
+TELEMETRY_SEQ_PREFIX = "tel:"      # telemetry agent pushes (telemetry/agent.py)
+CONTROL_NAMESPACES: Tuple[str, ...] = (CONTROL_SEQ_PREFIX, TELEMETRY_SEQ_PREFIX)
 
-# Per-job membership hooks (wired by MembershipManager.install):
-# control_handler(header, decoded_value) -> (code, message) serves
-# mbr:req:* frames on the coordinator party; evicted_fn() -> the
-# membership eviction ghost table {party: eviction_epoch} lets the
-# expire loop reap parked frames from KNOWN-evicted sources. The sweep
-# is deliberately keyed off the eviction table rather than "not in the
-# roster": a fresh joiner may legitimately send before a slow member has
-# applied the admitting sync, and a roster-complement sweep would reap
-# (and tombstone) those frames, wedging the eventual recv.
-_control_handlers: Dict[str, Callable] = {}
+# Per-job control/membership hooks. Control handlers are keyed by
+# (job_name, seq-id prefix) — membership registers CONTROL_SEQ_PREFIX
+# (via the legacy set_control_handler wrapper), the telemetry collector
+# registers TELEMETRY_SEQ_PREFIX, and tests may register ad-hoc
+# prefixes. handler(header, decoded_value) -> (code, message); the
+# verdict rides back in the frame's ack. evicted_fn() -> the membership
+# eviction ghost table {party: eviction_epoch} lets the expire loop reap
+# parked frames from KNOWN-evicted sources. The sweep is deliberately
+# keyed off the eviction table rather than "not in the roster": a fresh
+# joiner may legitimately send before a slow member has applied the
+# admitting sync, and a roster-complement sweep would reap (and
+# tombstone) those frames, wedging the eventual recv.
+_control_handlers: Dict[Tuple[str, str], Callable] = {}
 _evicted_fns: Dict[str, Callable[[], Dict[str, int]]] = {}
 _hooks_lock = threading.Lock()
 
@@ -71,14 +80,30 @@ _hooks_lock = threading.Lock()
 _stores: "weakref.WeakSet[RendezvousStore]" = weakref.WeakSet()
 
 
-def set_control_handler(job_name: str, handler: Callable) -> None:
+def register_control_prefix(
+    job_name: str, prefix: str, handler: Callable
+) -> None:
+    """Route string seq ids starting with ``prefix`` on ``job_name`` to
+    ``handler(header, decoded_value) -> (code, message)`` instead of
+    parking them for a consumer."""
+    if not prefix or not isinstance(prefix, str):
+        raise ValueError("control prefix must be a non-empty string")
     with _hooks_lock:
-        _control_handlers[job_name] = handler
+        _control_handlers[(job_name, prefix)] = handler
+
+
+def unregister_control_prefix(job_name: str, prefix: str) -> None:
+    with _hooks_lock:
+        _control_handlers.pop((job_name, prefix), None)
+
+
+def set_control_handler(job_name: str, handler: Callable) -> None:
+    """Back-compat wrapper: membership's ``mbr:req:*`` handler."""
+    register_control_prefix(job_name, CONTROL_SEQ_PREFIX, handler)
 
 
 def clear_control_handler(job_name: str) -> None:
-    with _hooks_lock:
-        _control_handlers.pop(job_name, None)
+    unregister_control_prefix(job_name, CONTROL_SEQ_PREFIX)
 
 
 def set_evicted_fn(job_name: str, fn: Callable[[], Dict[str, int]]) -> None:
@@ -309,6 +334,20 @@ class RendezvousStore:
         # common case (consumer already parked in take()) resolves the
         # waiter one hop sooner.
         self._inline_decode_max = 64 * 1024
+        # Per-instance stats mirror the process-global registry series:
+        # co-located stores (combined proxies, tests) share one series,
+        # so get_stats() must count from a local dict, not the registry
+        # (docs/observability.md).
+        _reg = telemetry_metrics.get_registry()
+        self._m_recv_ops = _reg.counter(
+            "fed_transport_recv_ops_total",
+            "Frames offered to the rendezvous store (data, ping, control).",
+        )
+        self._m_ghost = _reg.counter(
+            "fed_transport_ghost_evicted_total",
+            "Parked frames purged because their source party was evicted.",
+        )
+        self._stats_lock = threading.Lock()
         self._stats = {"receive_op_count": 0, "ghost_evicted": 0}
         # Readiness-ping bookkeeping (barrier mutuality): which peers
         # have pinged this receiver, by the header's src when the lane
@@ -406,8 +445,8 @@ class RendezvousStore:
             # is moot), and the barrier needs to know WHO pinged
             # (ping_others mutuality — a party must not pass its barrier
             # and tear down while a peer has not reached it yet).
+            self._bump_recv()
             with self._lock:
-                self._stats["receive_op_count"] += 1
                 src = header.get("src") or ""
                 if src:
                     self._ping_srcs.add(src)
@@ -432,47 +471,66 @@ class RendezvousStore:
                 CODE_PICKLE_FORBIDDEN,
                 "pickle payloads are disabled (allow_pickle_payloads=False)",
             )
-        if isinstance(key[0], str) and key[0].startswith(CONTROL_SEQ_PREFIX):
-            # Membership control frame: dispatched to the job's handler
-            # (coordinator party only), never parked — the handler's
-            # verdict rides back in this frame's ack, so a rejected join
-            # fails the sender's future with the 403 it earned.
+        if isinstance(key[0], str):
+            # Control frame (membership request, telemetry push, ...):
+            # dispatched to the prefix's registered handler, never parked
+            # — the handler's verdict rides back in this frame's ack, so
+            # a rejected join fails the sender's future with the 403 it
+            # earned. A reserved-namespace frame with no handler at this
+            # party (join to a non-coordinator, push to a non-collector)
+            # is refused rather than parked.
+            handler = prefix = None
             with _hooks_lock:
-                handler = _control_handlers.get(job)
-            if handler is None:
-                return (
-                    CODE_FORBIDDEN,
-                    f"no membership coordinator at this party for {key[0]!r}",
-                )
-            try:
-                value = self._decode_fn(header, payload)
-            except BaseException:  # noqa: BLE001 - surfaced in the ack
-                logger.warning(
-                    "failed to decode membership control frame %s", key,
-                    exc_info=True,
-                )
-                return CODE_INTERNAL_ERROR, "undecodable control frame"
-            with self._lock:
-                self._stats["receive_op_count"] += 1
-            try:
-                code, msg = handler(header, value)
-            except Exception as e:  # noqa: BLE001 - surfaced in the ack
-                logger.warning(
-                    "membership control handler failed for %s", key,
-                    exc_info=True,
-                )
-                return CODE_INTERNAL_ERROR, f"control handler error: {e!r}"
-            if tracing.is_enabled():
-                import time
+                for (j, p), h in _control_handlers.items():
+                    if j == job and key[0].startswith(p):
+                        handler, prefix = h, p
+                        break
+            if handler is not None or key[0].startswith(CONTROL_NAMESPACES):
+                if handler is None:
+                    role = (
+                        "membership coordinator"
+                        if key[0].startswith(CONTROL_SEQ_PREFIX)
+                        else "telemetry collector"
+                        if key[0].startswith(TELEMETRY_SEQ_PREFIX)
+                        else "control handler"
+                    )
+                    return (
+                        CODE_FORBIDDEN,
+                        f"no {role} at this party for {key[0]!r}",
+                    )
+                try:
+                    value = self._decode_fn(header, payload)
+                except BaseException:  # noqa: BLE001 - surfaced in the ack
+                    logger.warning(
+                        "failed to decode control frame %s", key,
+                        exc_info=True,
+                    )
+                    return CODE_INTERNAL_ERROR, "undecodable control frame"
+                self._bump_recv()
+                try:
+                    code, msg = handler(header, value)
+                except Exception as e:  # noqa: BLE001 - surfaced in the ack
+                    logger.warning(
+                        "control handler failed for %s", key, exc_info=True,
+                    )
+                    return CODE_INTERNAL_ERROR, f"control handler error: {e!r}"
+                # Telemetry pushes are not traced: a span per push would
+                # feed back into the next push's span batch forever.
+                if tracing.is_enabled() and not key[0].startswith(
+                    TELEMETRY_SEQ_PREFIX
+                ):
+                    import time
 
-                tracing.record(
-                    "membership", header.get("src", ""), header["up"],
-                    header["down"], nbytes, time.perf_counter(),
-                    ok=code == CODE_OK, event="control",
-                )
-            return code, msg
+                    tracing.record(
+                        "membership" if prefix == CONTROL_SEQ_PREFIX
+                        else "control",
+                        header.get("src", ""), header["up"],
+                        header["down"], nbytes, time.perf_counter(),
+                        ok=code == CODE_OK, event="control",
+                    )
+                return code, msg
+        self._bump_recv()
         with self._lock:
-            self._stats["receive_op_count"] += 1
             if key in self._consumed:
                 # Duplicate of an already-delivered frame (ack-lost resend):
                 # acknowledge and drop. Not traced — it carried no new data.
@@ -572,7 +630,10 @@ class RendezvousStore:
             for key in victims:
                 self._arrived.pop(key, None)
                 self._mark_consumed(key)
-            self._stats["ghost_evicted"] += len(victims)
+        if victims:
+            with self._stats_lock:
+                self._stats["ghost_evicted"] += len(victims)
+            self._m_ghost.inc(len(victims))
         if victims:
             logger.info(
                 "evicted %d parked frame(s) from departed party %r",
@@ -580,8 +641,13 @@ class RendezvousStore:
             )
         return len(victims)
 
+    def _bump_recv(self) -> None:
+        with self._stats_lock:
+            self._stats["receive_op_count"] += 1
+        self._m_recv_ops.inc()
+
     def get_stats(self) -> Dict:
-        with self._lock:
+        with self._stats_lock:
             return dict(self._stats)
 
     def ping_sources(self) -> Tuple[set, int]:

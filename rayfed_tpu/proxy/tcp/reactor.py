@@ -65,8 +65,27 @@ import msgpack
 
 from rayfed_tpu.proxy.tcp import sockio, wire
 from rayfed_tpu.proxy.tcp.pipeline import _Inflight
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
+
+# Lane-level health series (docs/observability.md). Module-scope: lanes
+# come and go per peer, the series are process totals.
+_REG = telemetry_metrics.get_registry()
+_m_open_lanes = _REG.gauge(
+    "fed_transport_open_lanes", "Reactor sender lanes currently open."
+)
+_m_lane_dials = _REG.counter(
+    "fed_transport_lane_dials_total", "Successful lane (re)connects."
+)
+_m_lane_breaks = _REG.counter(
+    "fed_transport_lane_breaks_total",
+    "Lane connection breaks (frames resend after reconnect).",
+)
+_m_inline_sends = _REG.counter(
+    "fed_transport_inline_sends_total",
+    "Small frames written zero-hop on the caller's thread.",
+)
 
 _EPOLLIN = getattr(select, "EPOLLIN", 0x001)
 _EPOLLOUT = getattr(select, "EPOLLOUT", 0x004)
@@ -649,6 +668,7 @@ class ReactorLane:
         self._dialing = False
         self._inline_busy = False
         self._reactor.add_ticker(self._tick)
+        _m_open_lanes.inc()
 
     # -- submission (any thread) ---------------------------------------------
 
@@ -720,6 +740,7 @@ class ReactorLane:
             with self._lock:
                 self._inline_busy = False
                 backlog = bool(self._pending or self._outbox)
+            _m_inline_sends.inc()
             if backlog:
                 self._reactor.run_soon(self._pump)
         return True
@@ -737,6 +758,7 @@ class ReactorLane:
             self._outbox.clear()
             sock, fd = self._sock, self.fd
             self._sock, self.fd = None, -1
+        _m_open_lanes.inc(-1)
         err = ConnectionError("sender stopped")
         for job in jobs:
             if not job.out.done():
@@ -921,6 +943,7 @@ class ReactorLane:
             if self._closed:
                 return
             self._broken = True
+            _m_lane_breaks.inc()
             sock, self._sock, fd, self.fd = self._sock, None, self.fd, -1
             self._outbox.clear()
             self._acks.reset()
@@ -994,6 +1017,7 @@ class ReactorLane:
             except OSError:
                 pass
             return
+        _m_lane_dials.inc()
         self._reactor.register(self)
         self._pump()
         if self._outbox:

@@ -71,6 +71,7 @@ from rayfed_tpu.proxy.rendezvous import RendezvousStore
 from rayfed_tpu.proxy.tcp import reactor as reactor_mod
 from rayfed_tpu.proxy.tcp import sockio, wire
 from rayfed_tpu.resilience.retry import Deadline, run_with_retry
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -623,11 +624,23 @@ class _DestWorker(threading.Thread):
 
 
 class TcpSenderProxy(SenderProxy):
+    # Registry label for this transport's send counter; the TPU and
+    # gRPC proxies override it (docs/observability.md).
+    _TRANSPORT = "tcp"
+
     def __init__(self, addresses, party, job_name, tls_config, proxy_config=None):
         super().__init__(addresses, party, job_name, tls_config, proxy_config)
         self._config = TcpCrossSiloMessageConfig.from_dict(self._proxy_config)
         self._workers: Dict[str, _DestWorker] = {}
         self._lock = threading.Lock()
+        # Send ops mirror into the process-global registry; get_stats()
+        # counts from the local dict so co-located proxies sharing the
+        # series stay per-instance (rayfed_tpu/telemetry/metrics.py).
+        self._m_send_ops = telemetry_metrics.get_registry().counter(
+            "fed_transport_send_ops_total",
+            "Data frames handed to the wire, by transport.",
+            labels=("transport",),
+        ).labels(transport=self._TRANSPORT)
         self._stats_lock = threading.Lock()
         self._stats = {"send_op_count": 0}
         self._reactors = None  # lazily acquired pool refs (reactor mode)
@@ -657,9 +670,10 @@ class TcpSenderProxy(SenderProxy):
         return None
 
     def _bump_stat(self, key: str) -> None:
-        # += on a dict value is not atomic across worker/reader threads.
+        assert key == "send_op_count", key
         with self._stats_lock:
             self._stats[key] += 1
+        self._m_send_ops.inc()
 
     def start(self) -> None:
         pass  # workers spin up lazily per destination
@@ -680,7 +694,8 @@ class TcpSenderProxy(SenderProxy):
         return out
 
     def get_stats(self) -> Dict:
-        return dict(self._stats)
+        with self._stats_lock:
+            return dict(self._stats)
 
     def get_proxy_config(self, dest_party: Optional[str] = None):
         """The effective messaging config — per-destination overrides

@@ -141,6 +141,8 @@ class TpuSenderProxy(TcpSenderProxy):
     onto the destination party's sub-mesh and only a reference frame is
     sent (colocated deployments)."""
 
+    _TRANSPORT = "tpu"  # fed_transport_send_ops_total{transport="tpu"}
+
     def _try_encode_special(self, value, is_error: bool, cfg,
                             dest_party=None):
         if is_error:

@@ -70,8 +70,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from rayfed_tpu import tracing
 from rayfed_tpu._private.constants import PING_SEQ_ID
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
+
+_m_injected = telemetry_metrics.get_registry().counter(
+    "fed_resilience_injected_faults_total",
+    "Faults injected by the active schedule, by fault kind.",
+    labels=("fault",),
+)
 
 FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "partition", "crash")
 
@@ -379,6 +386,7 @@ class InjectingSenderProxy:
             "fault", dst, str(up), str(down), 0, time.perf_counter(),
             ok=False,
         )
+        _m_injected.labels(fault=rule.fault).inc()
         if is_ping:
             # Ping cadence is timing-dependent; tracing ping faults would
             # make same-seed traces diverge between runs.

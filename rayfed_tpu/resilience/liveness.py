@@ -48,12 +48,20 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, Iterable, Optional
 
 from rayfed_tpu import tracing
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
 
 ALIVE = "ALIVE"
 SUSPECT = "SUSPECT"
 DEAD = "DEAD"
+
+_m_peer_state = telemetry_metrics.get_registry().gauge(
+    "fed_liveness_peer_state",
+    "Local liveness verdict per monitored peer (0=ALIVE 1=SUSPECT 2=DEAD).",
+    labels=("peer",),
+)
+_STATE_CODE = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
 
 
 @dataclasses.dataclass
@@ -136,6 +144,7 @@ class LivenessMonitor:
                 return
             self._misses[party] = 0
             self._peers = sorted(set(self._peers) | {party})
+        _m_peer_state.labels(peer=party).set(0)
 
     def remove_peer(self, party: str) -> None:
         """Stop monitoring ``party`` (left or evicted): its outstanding
@@ -145,6 +154,7 @@ class LivenessMonitor:
             self._pending.pop(party, None)
             self._issued_at.pop(party, None)
             self._peers = [p for p in self._peers if p != party]
+        _m_peer_state.remove(peer=party)
 
     # -- state machine (also driven directly by tests via tick()) ------
     def tick(self) -> None:
@@ -196,6 +206,7 @@ class LivenessMonitor:
                 return
             prev = self._misses[p]
             self._misses[p] = 0
+        _m_peer_state.labels(peer=p).set(0)
         if prev >= self._config.suspect_after:
             logger.info("party %s is ALIVE again (was %s)",
                         p, self._state_for(prev))
@@ -206,6 +217,7 @@ class LivenessMonitor:
                 return
             self._misses[p] += 1
             n = self._misses[p]
+        _m_peer_state.labels(peer=p).set(_STATE_CODE[self._state_for(n)])
         tracing.record("hb", p, "", "", 0, time.perf_counter(), ok=False)
         if n == self._config.suspect_after or n == self._config.dead_after:
             logger.warning(

@@ -40,6 +40,13 @@ from rayfed_tpu import api as fed
 from rayfed_tpu._private.global_context import get_global_context
 from rayfed_tpu.config import ServingConfig
 from rayfed_tpu.fed_object import FedObject
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
+
+_m_client_submits = telemetry_metrics.get_registry().counter(
+    "fed_serving_client_submits_total",
+    "Requests submitted through a ServeHandle, by serving party.",
+    labels=("party",),
+)
 
 
 @fed.remote
@@ -106,6 +113,7 @@ class ServeHandle:
         if mode == "beam":
             opts["n_beams"] = int(n_beams)
         prompt = [int(t) for t in prompt]
+        _m_client_submits.labels(party=self.party).inc()
         return (
             _serve_submit.party(self.party)
             .options(eager=False)

@@ -55,6 +55,7 @@ from rayfed_tpu.config import ServingConfig
 from rayfed_tpu.models import transformer as tfm
 from rayfed_tpu.serving.kv_pool import KVPool
 from rayfed_tpu.serving.publish import ModelBank
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
 
@@ -157,6 +158,43 @@ class InferenceServer:
             "steps": 0,
         }
         self._latencies_ms: "deque[float]" = deque(maxlen=4096)
+        # Telemetry mirrors of the stats dict (docs/observability.md);
+        # stats() stays the per-instance source of truth.
+        _reg = telemetry_metrics.get_registry()
+        _events = _reg.counter(
+            "fed_serving_requests_total",
+            "Serving requests by lifecycle event.",
+            labels=("server", "event"),
+        )
+        self._m_events = {
+            k: _events.labels(server=name, event=k)
+            for k in ("submitted", "completed", "rejected")
+        }
+        self._m_prefix_hits = _reg.counter(
+            "fed_serving_prefix_hits_total", "Prefill prefix-cache hits.",
+            labels=("server",),
+        ).labels(server=name)
+        self._m_tokens = _reg.counter(
+            "fed_serving_tokens_total", "Tokens generated.",
+            labels=("server",),
+        ).labels(server=name)
+        self._m_steps = _reg.counter(
+            "fed_serving_steps_total", "Batched decode iterations.",
+            labels=("server",),
+        ).labels(server=name)
+        self._m_pending = _reg.gauge(
+            "fed_serving_pending", "Requests awaiting admission.",
+            labels=("server",),
+        ).labels(server=name)
+        self._m_active = _reg.gauge(
+            "fed_serving_active", "Requests in the decode batch.",
+            labels=("server",),
+        ).labels(server=name)
+        self._m_latency = _reg.histogram(
+            "fed_serving_latency_ms",
+            "End-to-end request latency (enqueue to finish).",
+            labels=("server",),
+        ).labels(server=name)
         if params is not None:
             self.bank.publish(params)
         self._engine = threading.Thread(
@@ -296,6 +334,7 @@ class InferenceServer:
                 raise ServerStoppedError("server is stopped")
             if len(self._pending) >= self.scfg.max_pending:
                 self._stats["rejected"] += 1
+                self._m_events["rejected"].inc()
                 raise ServerOverloadedError(
                     f"pending queue full ({self.scfg.max_pending}); "
                     "back off and resubmit"
@@ -314,7 +353,9 @@ class InferenceServer:
             )
             req.timing["enqueue"] = now
             self._stats["submitted"] += 1
+            self._m_events["submitted"].inc()
             self._pending.append(req)
+            self._m_pending.set(len(self._pending))
             self._cond.notify_all()
         tracing.record_request(rid, "enqueue", t_s=now,
                                prompt_len=int(prompt.size), mode=mode)
@@ -384,6 +425,8 @@ class InferenceServer:
             doomed = list(self._pending) + list(self._active.values())
             self._pending.clear()
             self._active.clear()
+            self._m_pending.set(0)
+            self._m_active.set(0)
         for req in doomed:
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -408,6 +451,7 @@ class InferenceServer:
                 else:
                     slot = -1
                 self._pending.popleft()
+                self._m_pending.set(len(self._pending))
             try:
                 self._admit_one(req, slot)
             except BaseException as e:  # noqa: BLE001 - per-request fault
@@ -450,6 +494,7 @@ class InferenceServer:
             )
             req.prefix_reuse = True
             self._stats["prefix_hits"] += 1
+            self._m_prefix_hits.inc()
         else:
             bucket = next(
                 (b for b in self._buckets if b >= plen), self._buckets[-1]
@@ -481,6 +526,7 @@ class InferenceServer:
         else:
             with self._lock:
                 self._active[slot] = req
+                self._m_active.set(len(self._active))
 
     def _single_row_step(self, params, slot: int, token: int, pos: int):
         """One pool iteration with only ``slot`` live (all other rows are
@@ -527,6 +573,7 @@ class InferenceServer:
             )
             self.pool.replace(k, v)
             self._stats["steps"] += 1
+            self._m_steps.inc()
             logits_np = np.asarray(logits, np.float32)
             for req in reqs:
                 tok = self._sample(logits_np[req.slot], req)
@@ -538,6 +585,7 @@ class InferenceServer:
                 ):
                     with self._lock:
                         self._active.pop(req.slot, None)
+                        self._m_active.set(len(self._active))
                     self._finish(req)
 
     def _sample(self, logits: np.ndarray, req: _Request) -> int:
@@ -559,7 +607,10 @@ class InferenceServer:
         latency_ms = (now - req.enqueue_s) * 1e3
         with self._lock:
             self._stats["completed"] += 1
+            self._m_events["completed"].inc()
             self._stats["tokens_out"] += len(req.out)
+            self._m_tokens.inc(len(req.out))
+            self._m_latency.observe(latency_ms)
             self._latencies_ms.append(latency_ms)
         tracing.record_request(req.rid, "finish", t_s=now,
                                n_new=len(req.out), version=req.version)

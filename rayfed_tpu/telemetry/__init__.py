@@ -1,0 +1,172 @@
+"""Federation-wide telemetry plane (docs/observability.md).
+
+- :mod:`rayfed_tpu.telemetry.metrics` — process-wide metrics registry
+  every subsystem's ``get_stats()`` delegates to (``fed_<plane>_<name>``
+  naming).
+- :mod:`rayfed_tpu.telemetry.agent` — per-party agent pushing delta
+  snapshots + tracing spans to the collector over the inline
+  small-message lane (reserved ``tel:`` seq ids).
+- :mod:`rayfed_tpu.telemetry.collector` — collector-party fleet view,
+  cross-party trace stitching, Prometheus/JSON HTTP endpoint.
+
+Wired from ``fed.init(config={"telemetry": {...}})``; see
+:class:`rayfed_tpu.telemetry.config.TelemetryConfig` for the knobs.
+This module stays import-light (rendezvous imports ``.metrics`` at
+module scope); the agent/collector machinery loads on :func:`start`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Dict, Optional
+
+from rayfed_tpu.telemetry import metrics  # noqa: F401 - re-export
+from rayfed_tpu.telemetry.config import TelemetryConfig
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_agent = None
+_collector = None
+_http = None
+_job_name: Optional[str] = None
+_party: Optional[str] = None
+_we_enabled_tracing = False
+
+
+def resolve_collector(cfg: TelemetryConfig, parties) -> str:
+    """Configured collector party, else the lexicographically first
+    party (same default as the membership coordinator)."""
+    if cfg.collector:
+        return cfg.collector
+    return sorted(parties)[0]
+
+
+def start(
+    job_name: str,
+    party: str,
+    addresses: Dict[str, str],
+    cfg: TelemetryConfig,
+) -> None:
+    """Start this party's telemetry plane: the push agent everywhere,
+    plus the collector (and optional HTTP endpoint) when ``party`` is
+    the collector party. Idempotent per init; re-entrant after stop()."""
+    global _agent, _collector, _http, _job_name, _party, _we_enabled_tracing
+    from rayfed_tpu import tracing
+    from rayfed_tpu.telemetry.agent import TelemetryAgent
+    from rayfed_tpu.telemetry.collector import (
+        CollectorHTTPServer,
+        FleetCollector,
+    )
+
+    with _lock:
+        _stop_locked()
+        _job_name, _party = job_name, party
+        if cfg.enable_tracing and not tracing.is_enabled():
+            tracing.enable()
+            _we_enabled_tracing = True
+        collector_party = resolve_collector(cfg, addresses or [party])
+        if party == collector_party:
+            _collector = FleetCollector(job_name, party, cfg, addresses)
+            _collector.register()
+            if cfg.http_port is not None:
+                try:
+                    _http = CollectorHTTPServer(
+                        _collector, cfg.http_host, cfg.http_port
+                    )
+                    logger.info("telemetry endpoint at %s", _http.url)
+                except Exception:  # noqa: BLE001 - endpoint is optional
+                    logger.warning(
+                        "telemetry HTTP endpoint failed to start",
+                        exc_info=True,
+                    )
+                    _http = None
+        _agent = TelemetryAgent(
+            party, job_name, collector_party, cfg,
+            local_collector=_collector,
+        )
+        _agent.start()
+
+
+def _stop_locked(flush: bool = False) -> None:
+    global _agent, _collector, _http, _we_enabled_tracing
+    if _agent is not None:
+        try:
+            _agent.stop(flush=flush)
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        _agent = None
+    if _http is not None:
+        try:
+            _http.stop()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        _http = None
+    if _collector is not None:
+        try:
+            _collector.unregister()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        _collector = None
+    if _we_enabled_tracing:
+        from rayfed_tpu import tracing
+
+        tracing.disable()
+        _we_enabled_tracing = False
+
+
+def stop(flush: bool = True) -> None:
+    with _lock:
+        _stop_locked(flush=flush)
+
+
+def is_running() -> bool:
+    return _agent is not None
+
+
+def get_agent():
+    return _agent
+
+
+def get_collector():
+    return _collector
+
+
+def http_url() -> Optional[str]:
+    return _http.url if _http is not None else None
+
+
+def telemetry_snapshot() -> dict:
+    """The fleet view on the collector party; this party's local
+    registry snapshot elsewhere (``fleet`` key tells which you got)."""
+    col = _collector
+    if col is not None:
+        view = col.fleet_view()
+        url = http_url()
+        if url:
+            view["endpoint"] = url
+        return view
+    return {
+        "fleet": False,
+        "job": _job_name,
+        "party": _party,
+        "metrics": metrics.get_registry().snapshot(),
+    }
+
+
+def export_fleet_trace(path: Optional[str] = None) -> dict:
+    """The collector's stitched cross-party trace. With ``path``, also
+    written as JSON (``tools/trace_view.py --fleet`` input format)."""
+    col = _collector
+    if col is None:
+        raise RuntimeError(
+            "export_fleet_trace() must run on the collector party "
+            "(no fleet collector here)"
+        )
+    doc = col.fleet_trace()
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, default=str)
+    return doc

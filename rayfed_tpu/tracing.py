@@ -37,6 +37,10 @@ _use_jax_annotations = False
 _lock = threading.Lock()
 _MAX_SPANS = 10000
 _spans: Deque["Span"] = deque(maxlen=_MAX_SPANS)
+# Monotonic append counter: every span gets the next index so the
+# telemetry agent can harvest "spans since my last push" even though
+# the ring drops old entries (rayfed_tpu/telemetry/agent.py).
+_span_seq = 0
 _MAX_REQUEST_EVENTS = 20000
 _request_events: Deque["RequestEvent"] = deque(maxlen=_MAX_REQUEST_EVENTS)
 
@@ -52,6 +56,7 @@ class Span:
     duration_s: float
     ok: bool = True
     extra: Dict = field(default_factory=dict)
+    idx: int = -1             # ring-append index (monotonic per process)
 
 
 def enable(jax_annotations: bool = False) -> None:
@@ -83,6 +88,27 @@ def get_spans(kind: Optional[str] = None) -> List[Span]:
     if kind is not None:
         spans = [s for s in spans if s.kind == kind]
     return spans
+
+
+def spans_since(idx: int, limit: Optional[int] = None) -> List[Span]:
+    """Spans with ring index > ``idx``, oldest first (optionally the
+    newest ``limit`` of them). The ring is append-ordered, so walk it
+    from the right and stop at the watermark instead of scanning all
+    10k entries on every telemetry push."""
+    out: List[Span] = []
+    with _lock:
+        for s in reversed(_spans):
+            if s.idx <= idx:
+                break
+            out.append(s)
+            if limit is not None and len(out) >= limit:
+                break
+    out.reverse()
+    return out
+
+
+def last_span_index() -> int:
+    return _span_seq - 1
 
 
 # Kinds whose spans bracket the full operation (duration is meaningful);
@@ -259,6 +285,7 @@ def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
     spans with the buffered round tags this way."""
     if not _enabled:
         return
+    global _span_seq
     with _lock:
         _spans.append(
             Span(
@@ -271,8 +298,10 @@ def record(kind: str, peer: str, upstream_seq_id: str, downstream_seq_id: str,
                 duration_s=time.perf_counter() - start_s,
                 ok=ok,
                 extra=extra,
+                idx=_span_seq,
             )
         )
+        _span_seq += 1
 
 
 # -- per-request serving timeline (docs/serving.md) -------------------------
@@ -399,6 +428,7 @@ class span:
             return False
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(exc_type, exc, tb)
+        global _span_seq
         record = Span(
             kind=self._kind,
             peer=self._peer,
@@ -410,5 +440,7 @@ class span:
             ok=exc_type is None,
         )
         with _lock:
+            record.idx = _span_seq
+            _span_seq += 1
             _spans.append(record)
         return False

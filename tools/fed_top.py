@@ -1,0 +1,226 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""``top`` for a federation: a live fleet view off the telemetry
+collector's ``/fleet`` endpoint (docs/observability.md).
+
+Usage::
+
+    python tools/fed_top.py --url http://127.0.0.1:9100 [--interval 1.0]
+    python tools/fed_top.py --url http://127.0.0.1:9100 --once --plain
+    python tools/fed_top.py --file fleet.json --once
+
+One row per party: liveness/staleness, membership epoch, transport
+throughput (sends/s and inline-lane share, derived from successive
+scrapes), open lanes, async-aggregator buffer depth and published
+version, serving tokens/s and queue depths. The header carries the
+fleet epoch and roster so a membership change is visible the scrape it
+lands. Curses when there is a TTY, ``--plain`` (or no curses) falls
+back to clear-and-reprint; dependency-free either way — it must run on
+the bare host whose job just wedged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def fetch(args) -> dict:
+    if args.file:
+        with open(args.file, encoding="utf-8") as f:
+            return json.load(f)
+    url = args.url.rstrip("/") + "/fleet"
+    with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _series_sum(metrics: dict, name: str, **match) -> float:
+    """Sum of a metric's series values filtered by label equality."""
+    m = metrics.get(name)
+    if m is None:
+        return 0.0
+    total = 0.0
+    for s in m.get("series", []):
+        labels = s.get("labels", {})
+        if all(labels.get(k) == v for k, v in match.items()):
+            v = s.get("value")
+            total += v["count"] if isinstance(v, dict) else v
+    return total
+
+
+def _rate(curr: float, prev: float, dt: float) -> float:
+    if dt <= 0 or prev > curr:  # restart/reset: no rate
+        return 0.0
+    return (curr - prev) / dt
+
+
+class Model:
+    """Holds the previous scrape so rates come from paired samples."""
+
+    def __init__(self) -> None:
+        self._prev: dict = {}
+        self._prev_t: float = 0.0
+
+    def rows(self, view: dict):
+        now = time.monotonic()
+        dt = now - self._prev_t if self._prev_t else 0.0
+        header = {
+            "job": view.get("job", "?"),
+            "collector": view.get("collector", "?"),
+            "epoch": view.get("epoch"),
+            "roster": view.get("roster") or [],
+            "stale_after_s": view.get("stale_after_s"),
+        }
+        rows = []
+        for party in sorted(view.get("parties", {})):
+            p = view["parties"][party]
+            m = p.get("metrics", {})
+            prev = self._prev.get(party, {})
+            sends = _series_sum(m, "fed_transport_send_ops_total")
+            inline = _series_sum(m, "fed_transport_inline_sends_total")
+            tokens = _series_sum(m, "fed_serving_tokens_total")
+            rows.append({
+                "party": party,
+                "stale": p.get("stale", False),
+                "liveness": p.get("liveness", "?"),
+                "in_roster": p.get("in_roster", True),
+                "age_s": p.get("age_s", 0.0),
+                "epoch": p.get("epoch"),
+                "send_rate": _rate(sends, prev.get("sends", 0.0), dt),
+                "inline_rate": _rate(inline, prev.get("inline", 0.0), dt),
+                "lanes": _series_sum(m, "fed_transport_open_lanes"),
+                "depth": _series_sum(m, "fed_async_buffer_depth"),
+                "version": _series_sum(m, "fed_async_version"),
+                "tok_rate": _rate(tokens, prev.get("tokens", 0.0), dt),
+                "pending": _series_sum(m, "fed_serving_pending"),
+                "active": _series_sum(m, "fed_serving_active"),
+            })
+            self._prev[party] = {
+                "sends": sends, "inline": inline, "tokens": tokens,
+            }
+        self._prev_t = now
+        return header, rows
+
+
+_COLS = (
+    ("PARTY", 10), ("STATE", 7), ("AGE", 6), ("EPOCH", 5),
+    ("SEND/S", 8), ("INL/S", 8), ("LANES", 5), ("BUF", 4),
+    ("VER", 4), ("TOK/S", 8), ("PEND", 5), ("ACT", 4),
+)
+
+
+def render_lines(header: dict, rows: list) -> list:
+    lines = [
+        f"fed_top  job={header['job']}  collector={header['collector']}  "
+        f"epoch={header['epoch']}  roster={','.join(header['roster'])}  "
+        f"{time.strftime('%H:%M:%S')}"
+    ]
+    lines.append("  ".join(f"{name:<{w}}" for name, w in _COLS))
+    for r in rows:
+        state = "STALE" if r["stale"] else r["liveness"]
+        if not r["in_roster"]:
+            state = "GONE"
+        cells = (
+            r["party"][:10], state[:7], f"{r['age_s']:.1f}s",
+            str(r["epoch"] if r["epoch"] is not None else "-"),
+            f"{r['send_rate']:.1f}", f"{r['inline_rate']:.1f}",
+            f"{int(r['lanes'])}", f"{int(r['depth'])}",
+            f"{int(r['version'])}", f"{r['tok_rate']:.1f}",
+            f"{int(r['pending'])}", f"{int(r['active'])}",
+        )
+        lines.append(
+            "  ".join(f"{c:<{w}}" for c, (_, w) in zip(cells, _COLS))
+        )
+    return lines
+
+
+def run_plain(args, model: Model) -> int:
+    while True:
+        try:
+            view = fetch(args)
+            header, rows = model.rows(view)
+            lines = render_lines(header, rows)
+        except Exception as e:  # noqa: BLE001 - keep refreshing
+            lines = [f"fed_top: scrape failed: {e}"]
+        if not args.once:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print("\n".join(lines))
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+def run_curses(args, model: Model) -> int:
+    import curses
+
+    def loop(screen) -> None:
+        curses.curs_set(0)
+        screen.nodelay(True)
+        while True:
+            try:
+                view = fetch(args)
+                header, rows = model.rows(view)
+                lines = render_lines(header, rows)
+            except Exception as e:  # noqa: BLE001 - keep refreshing
+                lines = [f"fed_top: scrape failed: {e}"]
+            screen.erase()
+            maxy, maxx = screen.getmaxyx()
+            for i, line in enumerate(lines[: maxy - 1]):
+                screen.addnstr(i, 0, line, maxx - 1)
+            screen.addnstr(
+                min(len(lines), maxy - 1), 0, "q to quit", maxx - 1
+            )
+            screen.refresh()
+            deadline = time.monotonic() + args.interval
+            while time.monotonic() < deadline:
+                if screen.getch() in (ord("q"), ord("Q")):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(loop)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live fleet view off the telemetry collector"
+    )
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="collector base URL (serves /fleet)")
+    src.add_argument("--file", help="render a saved /fleet JSON document")
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument(
+        "--once", action="store_true", help="one scrape, no refresh loop"
+    )
+    parser.add_argument(
+        "--plain", action="store_true",
+        help="clear-and-reprint instead of curses",
+    )
+    args = parser.parse_args(argv)
+    model = Model()
+    if args.once or args.plain or not sys.stdout.isatty():
+        return run_plain(args, model)
+    try:
+        return run_curses(args, model)
+    except Exception:  # noqa: BLE001 - no curses/terminal: fall back
+        return run_plain(args, model)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,153 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Telemetry-plane gate (docs/observability.md).
+
+Runs bench.py's 3-party observability stage (spawned processes, real TCP
+transport): paired telemetry-off/on windows of a tiny-aggregate round,
+then a scrape of the collector's HTTP endpoint at alice. FAILS LOUDLY —
+exit code 1 — when the telemetry plane starts costing training time or
+stops seeing the fleet. Wire this into CI so a change that quietly makes
+the hot path allocate (a label lookup per send), drops a producer out of
+the registry, or breaks cross-party trace stitching turns the build red.
+
+Four gates:
+
+  overhead  — ``metrics_overhead_pct`` (median over paired windows)
+              must stay <= the budget. The hot path is lock-cheap
+              increments and the agent is one thread waking per push
+              interval; telemetry must be indistinguishable from off,
+              not merely affordable.
+  series    — every core series must appear with samples in the
+              collector's /metrics scrape: transport send/recv/inline
+              counters, the agent's own push counter, the synthesized
+              staleness/epoch gauges, and the driver's aggregate
+              counter. A missing name means a producer silently fell
+              out of the registry.
+  fleet     — all 3 parties must be reporting in the /fleet view (a
+              party whose agent can't reach the collector shows up
+              missing here before anything else notices).
+  stitched  — at least one seq-id edge in /trace must carry spans from
+              two or more parties: the sender's push and the receiver's
+              recv/decode stitched into one timeline is THE
+              cross-party correlation contract.
+
+``fleet_scrape_ms`` is reported (and bounded loosely) so a collector
+that starts re-rendering the world per scrape shows up in the log.
+
+Budgets:
+
+  FEDTPU_OBS_BUDGET_PCT        default 3.0 — metrics_overhead_pct cap.
+  FEDTPU_OBS_SCRAPE_BUDGET_MS  default 1000 — /fleet scrape latency cap.
+  FEDTPU_BENCH_OBS_ROUNDS      default 60 rounds per window.
+  FEDTPU_OBS_WALL_BUDGET_S     default 300 — cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    budget_pct = float(os.environ.get("FEDTPU_OBS_BUDGET_PCT", "3.0"))
+    scrape_budget_ms = float(
+        os.environ.get("FEDTPU_OBS_SCRAPE_BUDGET_MS", "1000")
+    )
+    rounds = int(os.environ.get("FEDTPU_BENCH_OBS_ROUNDS", "60"))
+    wall_budget_s = float(os.environ.get("FEDTPU_OBS_WALL_BUDGET_S", "300"))
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            bench._obs_party, "tcp", (rounds,),
+            timeout_s=wall_budget_s, parties=bench._OBS3,
+        )
+    elapsed = time.monotonic() - t0
+
+    overhead = res["metrics_overhead_pct"]
+    scrape_ms = res["fleet_scrape_ms"]
+    missing = res["obs_series_missing"]
+    reporting = res["obs_parties_reporting"]
+    stitched = bool(res["obs_stitched"])
+    print(
+        f"overhead={overhead:.2f}% scrape={scrape_ms:.1f}ms "
+        f"parties={reporting}/{len(bench._OBS3)} "
+        f"stitched={stitched} missing={missing or 'none'} "
+        f"off={['%.2f' % x for x in res['obs_off_ms']]}ms "
+        f"on={['%.2f' % x for x in res['obs_on_ms']]}ms "
+        f"in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    failed = False
+    if overhead > budget_pct:
+        failed = True
+        print(
+            f"OBS REGRESSION: metrics_overhead_pct {overhead:.2f} is over "
+            f"the {budget_pct:.1f}% budget — the registry hot path must "
+            f"stay allocation-free increments and the agent one thread "
+            f"per push interval; something started doing per-op work.",
+            file=sys.stderr,
+        )
+    if missing:
+        failed = True
+        print(
+            f"OBS REGRESSION: core series missing from the collector "
+            f"scrape: {missing}. A producer fell out of the registry "
+            f"(renamed series, skipped registration at subsystem init, "
+            f"or the agent's delta never shipped it).",
+            file=sys.stderr,
+        )
+    if reporting < len(bench._OBS3):
+        failed = True
+        print(
+            f"OBS REGRESSION: only {reporting} of {len(bench._OBS3)} "
+            f"parties reporting in the fleet view — a party's agent "
+            f"can't reach the collector (push lane, control-prefix "
+            f"registration, or the delta protocol regressed).",
+            file=sys.stderr,
+        )
+    if not stitched:
+        failed = True
+        print(
+            "OBS REGRESSION: no seq-id edge in the fleet trace carries "
+            "spans from two or more parties — cross-party stitching is "
+            "broken (span harvest, wall-clock alignment, or the "
+            "collector's edge keying).",
+            file=sys.stderr,
+        )
+    if scrape_ms > scrape_budget_ms:
+        failed = True
+        print(
+            f"OBS REGRESSION: /fleet scrape took {scrape_ms:.0f}ms "
+            f"(budget {scrape_budget_ms:.0f}ms) — the collector should "
+            f"serve a merged in-memory view, not recompute the world.",
+            file=sys.stderr,
+        )
+    if failed:
+        return 1
+    print(f"obs gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,8 @@ _GLYPHS = {
     "publish": "P",
     "hb": "h",
     "membership": "M",
+    "control": "c",
+    "fault": "!",
 }
 
 
@@ -98,6 +100,52 @@ def render(doc: dict, width: int = 100, out=sys.stdout) -> int:
     return len(edges)
 
 
+def render_fleet(doc: dict, width: int = 100, out=sys.stdout) -> int:
+    """Render a collector fleet trace (``fed.export_fleet_trace``): one
+    swim-lane per party over the shared wall-clock window, then the
+    per-edge rows. A party's lane carries every span the collector
+    harvested from it — membership epoch bumps surface as ``M`` ticks, so
+    a roster change reads as a vertical seam across the lanes."""
+    edges = doc.get("edges", [])
+    events = [ev for e in edges for ev in e["events"]]
+    if not events:
+        out.write("(empty fleet timeline: no spans harvested)\n")
+        return 0
+    t0 = min(ev["t_s"] for ev in events)
+    t1 = max(ev["t_s"] + ev.get("dur_s", 0.0) for ev in events)
+    window = max(t1 - t0, 1e-9)
+    parties = list(doc.get("parties") or sorted(
+        {ev.get("party", "?") for ev in events}
+    ))
+    out.write(
+        f"fleet job={doc.get('job', '?')} collector="
+        f"{doc.get('collector', '?')} parties={len(parties)} "
+        f"edges={len(edges)} window={window * 1e3:.1f}ms  "
+        f"[{' '.join(f'{g}={k}' for k, g in _GLYPHS.items())} x=failed]\n"
+    )
+    label_w = max([len(p) for p in parties]
+                  + [min(len(f"{e['up']}->{e['down']}"), 28) for e in edges])
+    for party in parties:
+        lane = {
+            "events": [ev for ev in events if ev.get("party") == party]
+        }
+        out.write(
+            f"{party:<{label_w}} |{_render_edge(lane, t0, window, width)}| "
+            f"n={len(lane['events'])}\n"
+        )
+    out.write("-" * (label_w + width + 3) + "\n")
+    for edge in edges:
+        label = f"{edge['up']}->{edge['down']}"[:label_w]
+        last = max(
+            ev["t_s"] + ev.get("dur_s", 0.0) for ev in edge["events"]
+        )
+        out.write(
+            f"{label:<{label_w}} |{_render_edge(edge, t0, window, width)}| "
+            f"n={len(edge['events'])} last=+{(last - t0) * 1e3:.1f}ms\n"
+        )
+    return len(edges)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="text flamegraph for tracing.export_seq_timeline JSON"
@@ -106,13 +154,21 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--width", type=int, default=100, help="columns in the time axis"
     )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="render a collector fleet trace (fed.export_fleet_trace) "
+        "with per-party swim-lanes; auto-detected from the document",
+    )
     args = parser.parse_args(argv)
     for path in args.paths:
         if len(args.paths) > 1:
             print(f"== {path} ==")
         with open(path, encoding="utf-8") as f:
             doc = json.load(f)
-        render(doc, width=args.width)
+        if args.fleet or doc.get("fleet"):
+            render_fleet(doc, width=args.width)
+        else:
+            render(doc, width=args.width)
     return 0
 
 
