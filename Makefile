@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma serve async churn obs clean
+.PHONY: native test lint chaos latency scale dma shm serve async churn obs clean
 
 native:
 	python setup.py build_ext --inplace
@@ -48,6 +48,15 @@ scale:
 # loudly here.
 dma:
 	JAX_PLATFORMS=cpu python tools/dma_check.py
+
+# Shared-memory lane gate: same-host pushes over the /dev/shm ring must
+# beat loopback TCP by FEDTPU_SHM_RATIO (default 4.0x), with an
+# absolute FEDTPU_SHM_FLOOR_GBPS anti-gaming floor — a change that
+# re-adds a staging copy, breaks ring adoption (silent per-push socket
+# fallback), or serializes pushes behind the ring lock fails loudly
+# here. Mirrors the `shm` job in .github/workflows/tests.yml.
+shm: native
+	JAX_PLATFORMS=cpu python tools/shm_check.py
 
 # Serving gate (docs/serving.md): the inference engine under 8
 # concurrent clients with hot swaps mid-window must hold its

@@ -41,6 +41,15 @@ import sys
 import tempfile
 import time
 
+# gRPC-core WARNING logs (retry_service_config.cc's maxAttempts clamp
+# note among them) come from channels jaxlib and the parties create
+# internally. Set at MODULE level so it covers the driver AND every
+# spawned child — spawn re-imports this module, and subprocesses
+# inherit the driver's env — not just the _party_entry trampoline
+# (BENCH_r05's tails still carried the clamp spam from the psum/serve/
+# MFU children, which bypass _party_entry).
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
+
 PAYLOAD_MB = 100
 ROUNDS = 5
 REPS = 8  # best-of-N inside one job (single-core hosts are noisy)
@@ -132,7 +141,8 @@ def _party_entry(target, party, *rest):
 
 
 def _party_main(party, addresses, transport, result_path, device_dma=False,
-                pair_ceiling=False, num_streams=0, sharded=False):
+                pair_ceiling=False, num_streams=0, sharded=False,
+                shm=False):
     import numpy as np
 
     import rayfed_tpu as fed
@@ -144,6 +154,17 @@ def _party_main(party, addresses, transport, result_path, device_dma=False,
         comm["device_dma"] = True
     if num_streams:
         comm["num_streams"] = num_streams
+    if shm:
+        # Same-host zero-copy lane: payload bytes ride a /dev/shm ring,
+        # only descriptor frames cross the socket (proxy/lanes.py).
+        # Ring = in-flight payload budget: adoption is zero-copy, so all
+        # ROUNDS pipelined tensors pin their chunks until the driver's
+        # FedObjects die at the end of the rep — and with SPMD skew the
+        # receiver still holds rep N's tensors while rep N+1's burst is
+        # pushing, so the ring must cover TWO reps or pushes wait out
+        # shm_push_timeout_ms and fall back to the socket mid-rep.
+        comm["shm_enabled"] = True
+        comm["shm_ring_mb"] = 2 * ROUNDS * PAYLOAD_MB + 64
     fed.init(
         addresses=addresses,
         party=party,
@@ -439,10 +460,11 @@ def _free_ports(n):
 
 def run_transport(transport: str, device_dma: bool = False,
                   pair_ceiling: bool = False, num_streams: int = 0,
-                  sharded: bool = False) -> dict:
+                  sharded: bool = False, shm: bool = False) -> dict:
     res = _run_two_party(
         _party_main, transport,
-        (device_dma, pair_ceiling, num_streams, sharded), timeout_s=600,
+        (device_dma, pair_ceiling, num_streams, sharded, shm),
+        timeout_s=600,
     )
     import statistics
 
@@ -936,6 +958,25 @@ def _run_two_party(target, transport, extra_args, timeout_s=300,
             return json.load(f)
 
 
+# Stage failure diagnostics, keyed "<party_fn>[<key>]". A hung stage's
+# faulthandler stacks and phase marks land HERE and then in the headline
+# JSON line's "diagnostics" field — BENCH_r05's "bench party hung;
+# terminated" left nothing to root-cause with because the dump only went
+# to a stderr stream nobody kept.
+_DIAGNOSTICS: dict = {}
+
+
+def _record_diag(stage: str, err: BaseException) -> None:
+    msg = str(err)
+    head, sep, stacks = msg.partition("\n--- ")
+    entry = {"error": head.strip()[:500]}
+    if sep:
+        # The all-thread faulthandler dumps _run_two_party appended to
+        # the hang error, bounded so the JSON line stays printable.
+        entry["stacks_tail"] = ("--- " + stacks)[-4000:]
+    _DIAGNOSTICS[stage] = entry
+
+
 def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
                  cpu_force=False, parties=("alice", "bob"), timeout_s=300,
                  digits=2, extra_fields=None) -> dict:
@@ -966,7 +1007,16 @@ def _bench_stage(party_fn, res_field, env_var, default_rounds, keys, *,
                         )
                         break
                     except Exception as e:  # noqa: BLE001 - retried once
+                        if "bench party hung" in str(e):
+                            # The watchdog already burned timeout_s on
+                            # this window; a wedged stage hangs the same
+                            # way on retry and burns it AGAIN (BENCH_r05
+                            # paid 2x the budget for one dead key).
+                            # Capture the stacks and skip with reason.
+                            _record_diag(f"{party_fn.__name__}[{key}]", e)
+                            raise
                         if attempt == 2:
+                            _record_diag(f"{party_fn.__name__}[{key}]", e)
                             raise
                         print(
                             f"{party_fn.__name__} [{key}] window failed "
@@ -1896,6 +1946,33 @@ def _try_train_mfu():
     import subprocess
     import threading
 
+    # Fast pre-probe: spawning the child costs 240s of backend-init
+    # watchdog when no accelerator is reachable (the exact stall
+    # BENCH_r05 recorded). Skip immediately when the environment says
+    # there is nothing to init against; FEDTPU_MFU_FORCE=1 overrides
+    # for plugin platforms this heuristic cannot see.
+    if not os.environ.get("FEDTPU_MFU_FORCE"):
+        plat = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+        if plat and "tpu" not in plat and "axon" not in plat:
+            print(
+                f"train MFU bench skipped: JAX_PLATFORMS={plat!r} "
+                "selects no accelerator", file=sys.stderr,
+            )
+            return None
+        import glob as _glob
+
+        if not (
+            os.environ.get("PALLAS_AXON_POOL_IPS")
+            or _glob.glob("/dev/accel*")
+            or _glob.glob("/dev/vfio/*")
+        ):
+            print(
+                "train MFU bench skipped: no accelerator visible (no "
+                "PALLAS_AXON_POOL_IPS, no /dev/accel*); set "
+                "FEDTPU_MFU_FORCE=1 to attempt anyway", file=sys.stderr,
+            )
+            return None
+
     here = os.path.dirname(os.path.abspath(__file__))
     backend_deadline = int(os.environ.get("FEDTPU_MFU_BACKEND_DEADLINE", 240))
     hard_cap = int(os.environ.get("FEDTPU_MFU_HARD_CAP", 900))
@@ -2024,6 +2101,14 @@ def main() -> None:
     # mismatch alone; the paired median ratio is stable.
     native = run_transport("tcp", pair_ceiling=True)
     baseline = run_transport("grpc")
+    # Same-host zero-copy shm lane, same workload/processes layout as
+    # the tcp stage so tools/shm_check.py can gate the ratio against
+    # tcp_loopback_gbps (both keys from this run, same host regime).
+    shm_lane = {}
+    try:
+        _lane_stats(shm_lane, "shm_push_gbps", run_transport("tcp", shm=True))
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"shm bench skipped: {e!r}", file=sys.stderr)
     tpu_lanes = _try_tpu_lanes()
     result = {
         "metric": "2-party cross-party push throughput, 100MB float32 tensors",
@@ -2052,6 +2137,10 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"paired baseline skipped: {e!r}", file=sys.stderr)
     result.setdefault("vs_baseline", result["vs_baseline_unpaired"])
+    # The socket-lane number the shm gate normalizes by (median: robust
+    # to the one lucky rep "max" keeps for continuity).
+    result["tcp_loopback_gbps"] = round(native["median"], 3)
+    result.update(shm_lane)
     result.update(tpu_lanes)
     result.update(_try_data_plane())
     if mfu:
@@ -2134,6 +2223,8 @@ def main() -> None:
         result.update(_run_serve_bench())
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"serve bench skipped: {e!r}", file=sys.stderr)
+    if _DIAGNOSTICS:
+        result["diagnostics"] = _DIAGNOSTICS
     print(json.dumps(result))
 
 

@@ -25,11 +25,15 @@
 #include <Python.h>
 
 #include <errno.h>
+#include <fcntl.h>
 #include <poll.h>
+#include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -950,6 +954,527 @@ done:;
 }
 
 /* ------------------------------------------------------------------ */
+/* shm ring: same-host zero-copy bulk lane                             */
+/* ------------------------------------------------------------------ */
+
+/* One single-producer ring in a /dev/shm-backed file. The sender
+ * (creator) serializes payloads directly into it and ships only a tiny
+ * descriptor frame (ring name + offset + length) over the socket lane;
+ * the receiver maps the same file and adopts the bytes zero-copy as a
+ * ShmBuf that the pooled decode path consumes like any other buffer.
+ *
+ * Layout: a 4096-byte file header {u64 magic, u64 cap}, then a cap-byte
+ * data region of 64-byte-aligned chunks. Each chunk starts with a
+ * 64-byte header {u32 magic, u32 state, u64 size} (size = whole chunk,
+ * header + payload + padding, a multiple of 64), so adopted payloads are
+ * 64-byte aligned — the same alignment the receive pool guarantees.
+ *
+ * Concurrency contract: head/tail live in the CREATOR's ShmRing struct,
+ * not in shared memory — the receiver never scans the ring, it only maps
+ * explicit offsets named by descriptor frames. The one cross-process
+ * mutation is the chunk ``state`` flag: the receiver's ShmBuf dealloc
+ * atomically flips it to RELEASED, and the creator lazily reclaims
+ * contiguous released chunks from head on each push. A push that cannot
+ * find room returns None and the Python layer falls back to the socket
+ * lane — the ring can stall a push, never lose one. */
+
+#define SHM_FILE_HDR 4096
+#define SHM_CHUNK_HDR 64
+#define SHM_ALIGN 64
+#define SHM_FILE_MAGIC 0x4645445450534852ULL /* "FEDTPSHR" */
+#define SHM_CHUNK_MAGIC 0x46435348u          /* "FCSH" */
+#define SHM_STATE_INFLIGHT 0u
+#define SHM_STATE_RELEASED 1u
+
+typedef struct {
+    uint32_t magic;
+    uint32_t state;
+    uint64_t size;
+    char pad[SHM_CHUNK_HDR - 16];
+} ShmChunkHdr;
+
+typedef struct {
+    uint64_t magic;
+    uint64_t cap;
+} ShmFileHdr;
+
+typedef struct {
+    PyObject_HEAD
+    char *base;        /* mmap base (file offset 0); NULL once unmapped */
+    size_t cap;        /* data-region capacity in bytes */
+    int fd;
+    int creator;
+    int closed;
+    uint64_t head;     /* creator-side cumulative reclaim counter */
+    uint64_t tail;     /* creator-side cumulative write counter */
+    char path[256];
+} ShmRing;
+
+static char *shm_data(ShmRing *r) { return r->base + SHM_FILE_HDR; }
+
+#if defined(__x86_64__) || defined(_M_X64)
+#include <emmintrin.h>
+/* Bulk copy with non-temporal stores: a pushed payload is read next by
+ * the RECEIVER process, so filling the sender's cache with it is pure
+ * waste — streaming stores skip the read-for-ownership and raise copy
+ * bandwidth ~25% on this class of host. Head/tail fragments and small
+ * copies go through plain memcpy. */
+static void shm_copy(char *dst, const char *src, size_t n) {
+    const size_t NT_MIN = (size_t)1 << 20;
+    if (n < NT_MIN) {
+        memcpy(dst, src, n);
+        return;
+    }
+    size_t head = ((uintptr_t)dst) & 15 ? 16 - (((uintptr_t)dst) & 15) : 0;
+    if (head) {
+        memcpy(dst, src, head);
+        dst += head;
+        src += head;
+        n -= head;
+    }
+    size_t i = 0;
+    for (; i + 64 <= n; i += 64) {
+        __m128i a = _mm_loadu_si128((const __m128i *)(src + i));
+        __m128i b = _mm_loadu_si128((const __m128i *)(src + i + 16));
+        __m128i c = _mm_loadu_si128((const __m128i *)(src + i + 32));
+        __m128i d = _mm_loadu_si128((const __m128i *)(src + i + 48));
+        _mm_stream_si128((__m128i *)(dst + i), a);
+        _mm_stream_si128((__m128i *)(dst + i + 16), b);
+        _mm_stream_si128((__m128i *)(dst + i + 32), c);
+        _mm_stream_si128((__m128i *)(dst + i + 48), d);
+    }
+    _mm_sfence();
+    if (i < n) memcpy(dst + i, src + i, n - i);
+}
+#else
+static void shm_copy(char *dst, const char *src, size_t n) {
+    memcpy(dst, src, n);
+}
+#endif
+
+static void ShmRing_dealloc(PyObject *self) {
+    ShmRing *r = (ShmRing *)self;
+    if (r->creator && !r->closed && r->path[0]) unlink(r->path);
+    if (r->base) munmap(r->base, SHM_FILE_HDR + r->cap);
+    if (r->fd >= 0) close(r->fd);
+    Py_TYPE(self)->tp_free(self);
+}
+
+static PyTypeObject ShmRing_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    "rayfed_tpu._fastwire.ShmRing", /* tp_name */
+    sizeof(ShmRing),                /* tp_basicsize */
+};
+
+/* ShmBuf: a read-through view of one adopted chunk's payload. Dealloc
+ * flips the chunk's release flag so the creator can reclaim the space —
+ * the exact PooledBuf contract, with the pool replaced by the ring. */
+typedef struct {
+    PyObject_HEAD
+    ShmRing *ring;     /* strong ref keeps the mapping alive */
+    char *ptr;
+    Py_ssize_t len;
+    ShmChunkHdr *chunk;
+} ShmBuf;
+
+static void ShmBuf_dealloc(PyObject *self) {
+    ShmBuf *sb = (ShmBuf *)self;
+    if (sb->chunk) {
+        __atomic_store_n(&sb->chunk->state, SHM_STATE_RELEASED,
+                         __ATOMIC_RELEASE);
+        sb->chunk = NULL;
+    }
+    Py_XDECREF((PyObject *)sb->ring);
+    Py_TYPE(self)->tp_free(self);
+}
+
+static int ShmBuf_getbuffer(PyObject *self, Py_buffer *view, int flags) {
+    ShmBuf *sb = (ShmBuf *)self;
+    if (sb->ptr == NULL) {
+        PyErr_SetString(PyExc_ValueError, "ShmBuf is released");
+        return -1;
+    }
+    return PyBuffer_FillInfo(view, self, sb->ptr, sb->len, 0, flags);
+}
+
+static PyBufferProcs ShmBuf_as_buffer = {
+    ShmBuf_getbuffer,
+    NULL,
+};
+
+static Py_ssize_t ShmBuf_length(PyObject *self) {
+    return ((ShmBuf *)self)->len;
+}
+
+static PySequenceMethods ShmBuf_as_sequence = {
+    ShmBuf_length, /* sq_length — len(buf) == payload bytes */
+};
+
+static PyTypeObject ShmBuf_Type = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    "rayfed_tpu._fastwire.ShmBuf", /* tp_name */
+    sizeof(ShmBuf),                /* tp_basicsize */
+};
+
+/* Ring names come off the wire (descriptor frames), so they are
+ * validated as a single flat filename before touching the filesystem. */
+static int shm_name_ok(const char *name) {
+    size_t n = strlen(name);
+    if (n == 0 || n > 200) return 0;
+    for (size_t i = 0; i < n; i++) {
+        char c = name[i];
+        if (!((c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+              (c >= '0' && c <= '9') || c == '-' || c == '_' || c == '.'))
+            return 0;
+    }
+    if (name[0] == '.') return 0;
+    return 1;
+}
+
+static PyObject *shm_ring_alloc(void) {
+    ShmRing *r = PyObject_New(ShmRing, &ShmRing_Type);
+    if (r == NULL) return NULL;
+    r->base = NULL;
+    r->cap = 0;
+    r->fd = -1;
+    r->creator = 0;
+    r->closed = 0;
+    r->head = 0;
+    r->tail = 0;
+    r->path[0] = '\0';
+    return (PyObject *)r;
+}
+
+/* shm_ring_create(name, capacity) -> ShmRing
+ * Creates /dev/shm/<name> (0600, O_EXCL) sized header + capacity and
+ * maps it. The creator owns head/tail and unlinks the file on close. */
+static PyObject *fastwire_shm_ring_create(PyObject *self, PyObject *args) {
+    const char *name;
+    unsigned long long cap_arg;
+    if (!PyArg_ParseTuple(args, "sK", &name, &cap_arg)) return NULL;
+    if (!shm_name_ok(name)) {
+        PyErr_Format(PyExc_ValueError, "bad shm ring name %.220s", name);
+        return NULL;
+    }
+    size_t cap = (size_t)cap_arg;
+    if (cap < SHM_ALIGN) cap = SHM_ALIGN;
+    cap = (cap + SHM_ALIGN - 1) & ~((size_t)SHM_ALIGN - 1);
+
+    ShmRing *r = (ShmRing *)shm_ring_alloc();
+    if (r == NULL) return NULL;
+    snprintf(r->path, sizeof(r->path), "/dev/shm/%s", name);
+    r->fd = open(r->path, O_RDWR | O_CREAT | O_EXCL | O_CLOEXEC, 0600);
+    if (r->fd < 0) {
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        r->path[0] = '\0';
+        Py_DECREF(r);
+        return NULL;
+    }
+    if (ftruncate(r->fd, (off_t)(SHM_FILE_HDR + cap)) != 0) {
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        unlink(r->path);
+        r->path[0] = '\0';
+        Py_DECREF(r);
+        return NULL;
+    }
+    r->base = (char *)mmap(NULL, SHM_FILE_HDR + cap,
+                           PROT_READ | PROT_WRITE, MAP_SHARED, r->fd, 0);
+    if (r->base == MAP_FAILED) {
+        r->base = NULL;
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        unlink(r->path);
+        r->path[0] = '\0';
+        Py_DECREF(r);
+        return NULL;
+    }
+    r->cap = cap;
+    r->creator = 1;
+    ShmFileHdr *h = (ShmFileHdr *)r->base;
+    h->cap = (uint64_t)cap;
+    /* Magic last: an attacher that races creation sees zero magic and
+     * fails attach instead of reading a half-written header. */
+    __atomic_store_n(&h->magic, SHM_FILE_MAGIC, __ATOMIC_RELEASE);
+    return (PyObject *)r;
+}
+
+/* shm_ring_attach(name) -> ShmRing
+ * Maps an existing ring read-write (the release flags are written
+ * through this mapping). Never unlinks. */
+static PyObject *fastwire_shm_ring_attach(PyObject *self, PyObject *args) {
+    const char *name;
+    if (!PyArg_ParseTuple(args, "s", &name)) return NULL;
+    if (!shm_name_ok(name)) {
+        PyErr_Format(PyExc_ValueError, "bad shm ring name %.220s", name);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)shm_ring_alloc();
+    if (r == NULL) return NULL;
+    snprintf(r->path, sizeof(r->path), "/dev/shm/%s", name);
+    r->fd = open(r->path, O_RDWR | O_CLOEXEC);
+    if (r->fd < 0) {
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        Py_DECREF(r);
+        return NULL;
+    }
+    struct stat st;
+    if (fstat(r->fd, &st) != 0) {
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        Py_DECREF(r);
+        return NULL;
+    }
+    if (st.st_size < (off_t)SHM_FILE_HDR) {
+        PyErr_Format(PyExc_ValueError, "shm ring %.220s truncated", name);
+        Py_DECREF(r);
+        return NULL;
+    }
+    r->base = (char *)mmap(NULL, (size_t)st.st_size,
+                           PROT_READ | PROT_WRITE, MAP_SHARED, r->fd, 0);
+    if (r->base == MAP_FAILED) {
+        r->base = NULL;
+        PyErr_SetFromErrnoWithFilename(PyExc_OSError, r->path);
+        Py_DECREF(r);
+        return NULL;
+    }
+    ShmFileHdr *h = (ShmFileHdr *)r->base;
+    uint64_t magic = __atomic_load_n(&h->magic, __ATOMIC_ACQUIRE);
+    uint64_t cap = h->cap;
+    if (magic != SHM_FILE_MAGIC || cap == 0 ||
+        (uint64_t)st.st_size < SHM_FILE_HDR + cap) {
+        munmap(r->base, (size_t)st.st_size);
+        r->base = NULL;
+        PyErr_Format(PyExc_ValueError,
+                     "shm ring %.220s has bad header (magic/cap)", name);
+        Py_DECREF(r);
+        return NULL;
+    }
+    r->cap = (size_t)cap;
+    return (PyObject *)r;
+}
+
+static int shm_check_ring(PyObject *obj, const char **why) {
+    if (!PyObject_TypeCheck(obj, &ShmRing_Type)) {
+        *why = "expected a ShmRing";
+        return -1;
+    }
+    ShmRing *r = (ShmRing *)obj;
+    if (r->base == NULL || r->closed) {
+        *why = "ring is closed";
+        return -1;
+    }
+    return 0;
+}
+
+/* Advance head over contiguous released chunks. Creator only. */
+static void shm_reclaim(ShmRing *r) {
+    while (r->head < r->tail) {
+        size_t pos = (size_t)(r->head % r->cap);
+        ShmChunkHdr *c = (ShmChunkHdr *)(shm_data(r) + pos);
+        if (c->magic != SHM_CHUNK_MAGIC) break; /* corrupted: stop */
+        if (__atomic_load_n(&c->state, __ATOMIC_ACQUIRE) !=
+            SHM_STATE_RELEASED)
+            break;
+        uint64_t size = c->size;
+        if (size < SHM_CHUNK_HDR || size % SHM_ALIGN != 0 ||
+            r->head + size > r->tail)
+            break; /* corrupted size: stop reclaiming, ring degrades */
+        r->head += size;
+    }
+}
+
+/* shm_ring_push(ring, buffers) -> payload offset | None
+ * Copies the buffers back-to-back into one chunk (GIL released for the
+ * byte work) and returns the data-region offset of the payload, or None
+ * when the ring has no contiguous room (caller waits or falls back). */
+static PyObject *fastwire_shm_ring_push(PyObject *self, PyObject *args) {
+    PyObject *ring_obj, *seq;
+    if (!PyArg_ParseTuple(args, "OO", &ring_obj, &seq)) return NULL;
+    const char *why = NULL;
+    if (shm_check_ring(ring_obj, &why) < 0) {
+        PyErr_SetString(PyExc_ValueError, why);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (!r->creator) {
+        PyErr_SetString(PyExc_ValueError,
+                        "only the creating side may push into a shm ring");
+        return NULL;
+    }
+
+    PyObject *fast = PySequence_Fast(seq, "buffers must be a sequence");
+    if (!fast) return NULL;
+    Py_ssize_t nbufs = PySequence_Fast_GET_SIZE(fast);
+    std::vector<Py_buffer> views;
+    views.reserve((size_t)nbufs);
+    size_t total = 0;
+    for (Py_ssize_t i = 0; i < nbufs; i++) {
+        PyObject *item = PySequence_Fast_GET_ITEM(fast, i);
+        Py_buffer view;
+        if (PyObject_GetBuffer(item, &view, PyBUF_C_CONTIGUOUS) < 0) {
+            for (auto &v : views) PyBuffer_Release(&v);
+            Py_DECREF(fast);
+            return NULL;
+        }
+        views.push_back(view);
+        total += (size_t)view.len;
+    }
+    Py_DECREF(fast);
+
+    size_t need = (SHM_CHUNK_HDR + total + SHM_ALIGN - 1) &
+                  ~((size_t)SHM_ALIGN - 1);
+    int fits = 0;
+    size_t pos = 0;
+    if (need <= r->cap) {
+        shm_reclaim(r);
+        pos = (size_t)(r->tail % r->cap);
+        size_t wrem = (pos + need > r->cap) ? r->cap - pos : 0;
+        size_t free_bytes = r->cap - (size_t)(r->tail - r->head);
+        if (free_bytes >= wrem + need) {
+            fits = 1;
+            if (wrem) {
+                /* Wrap marker: a pre-released chunk covering the unusable
+                 * region tail, so reclaim walks past it naturally. */
+                ShmChunkHdr *w = (ShmChunkHdr *)(shm_data(r) + pos);
+                w->magic = SHM_CHUNK_MAGIC;
+                w->size = (uint64_t)wrem;
+                __atomic_store_n(&w->state, SHM_STATE_RELEASED,
+                                 __ATOMIC_RELEASE);
+                r->tail += wrem;
+                pos = 0;
+            }
+        }
+    }
+    if (!fits) {
+        for (auto &v : views) PyBuffer_Release(&v);
+        Py_RETURN_NONE;
+    }
+
+    char *dst = shm_data(r) + pos + SHM_CHUNK_HDR;
+    Py_BEGIN_ALLOW_THREADS;
+    for (auto &v : views) {
+        shm_copy(dst, (const char *)v.buf, (size_t)v.len);
+        dst += (size_t)v.len;
+    }
+    Py_END_ALLOW_THREADS;
+    for (auto &v : views) PyBuffer_Release(&v);
+
+    ShmChunkHdr *c = (ShmChunkHdr *)(shm_data(r) + pos);
+    c->magic = SHM_CHUNK_MAGIC;
+    c->size = (uint64_t)need;
+    __atomic_store_n(&c->state, SHM_STATE_INFLIGHT, __ATOMIC_RELEASE);
+    r->tail += need;
+    return PyLong_FromSize_t(pos + SHM_CHUNK_HDR);
+}
+
+/* shm_ring_adopt(ring, offset, nbytes) -> ShmBuf
+ * Zero-copy view of a pushed payload; validated against the chunk header
+ * so a bad descriptor raises instead of exposing arbitrary ring bytes. */
+static PyObject *fastwire_shm_ring_adopt(PyObject *self, PyObject *args) {
+    PyObject *ring_obj;
+    unsigned long long off, nbytes;
+    if (!PyArg_ParseTuple(args, "OKK", &ring_obj, &off, &nbytes))
+        return NULL;
+    const char *why = NULL;
+    if (shm_check_ring(ring_obj, &why) < 0) {
+        PyErr_SetString(PyExc_ValueError, why);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (off < SHM_CHUNK_HDR || off % SHM_ALIGN != 0 || off > r->cap ||
+        nbytes > r->cap - off) {
+        PyErr_Format(PyExc_ValueError,
+                     "shm descriptor out of range (off=%llu len=%llu "
+                     "cap=%zu)", off, nbytes, r->cap);
+        return NULL;
+    }
+    ShmChunkHdr *c =
+        (ShmChunkHdr *)(shm_data(r) + (size_t)off - SHM_CHUNK_HDR);
+    if (c->magic != SHM_CHUNK_MAGIC ||
+        __atomic_load_n(&c->state, __ATOMIC_ACQUIRE) !=
+            SHM_STATE_INFLIGHT ||
+        (uint64_t)SHM_CHUNK_HDR + nbytes > c->size) {
+        PyErr_SetString(PyExc_ValueError,
+                        "shm descriptor does not name a live chunk");
+        return NULL;
+    }
+    ShmBuf *sb = PyObject_New(ShmBuf, &ShmBuf_Type);
+    if (sb == NULL) return NULL;
+    Py_INCREF(r);
+    sb->ring = r;
+    sb->ptr = shm_data(r) + (size_t)off;
+    sb->len = (Py_ssize_t)nbytes;
+    sb->chunk = c;
+    return (PyObject *)sb;
+}
+
+/* shm_ring_cancel(ring, offset) -> None
+ * Release a pushed chunk whose descriptor frame was never delivered
+ * (sender-side fallback path) so its space is reclaimable. */
+static PyObject *fastwire_shm_ring_cancel(PyObject *self, PyObject *args) {
+    PyObject *ring_obj;
+    unsigned long long off;
+    if (!PyArg_ParseTuple(args, "OK", &ring_obj, &off)) return NULL;
+    const char *why = NULL;
+    if (shm_check_ring(ring_obj, &why) < 0) {
+        PyErr_SetString(PyExc_ValueError, why);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (off < SHM_CHUNK_HDR || off % SHM_ALIGN != 0 || off > r->cap) {
+        PyErr_SetString(PyExc_ValueError, "shm cancel offset out of range");
+        return NULL;
+    }
+    ShmChunkHdr *c =
+        (ShmChunkHdr *)(shm_data(r) + (size_t)off - SHM_CHUNK_HDR);
+    if (c->magic != SHM_CHUNK_MAGIC) {
+        PyErr_SetString(PyExc_ValueError, "shm cancel offset not a chunk");
+        return NULL;
+    }
+    __atomic_store_n(&c->state, SHM_STATE_RELEASED, __ATOMIC_RELEASE);
+    Py_RETURN_NONE;
+}
+
+/* shm_ring_occupancy(ring) -> (used_bytes, capacity)
+ * Creator-side view after a reclaim pass (telemetry + wait-for-space). */
+static PyObject *fastwire_shm_ring_occupancy(PyObject *self, PyObject *args) {
+    PyObject *ring_obj;
+    if (!PyArg_ParseTuple(args, "O", &ring_obj)) return NULL;
+    const char *why = NULL;
+    if (shm_check_ring(ring_obj, &why) < 0) {
+        PyErr_SetString(PyExc_ValueError, why);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (r->creator) shm_reclaim(r);
+    return Py_BuildValue("(KK)", (unsigned long long)(r->tail - r->head),
+                         (unsigned long long)r->cap);
+}
+
+/* shm_ring_close(ring) -> None
+ * Creator: unlink the file (new attaches fail, live mappings survive).
+ * Both sides: refuse further push/adopt. The mapping itself is unmapped
+ * at dealloc, AFTER the last adopted ShmBuf is gone — ShmBufs hold a
+ * strong ring reference, so close can never pull bytes out from under a
+ * consumer. */
+static PyObject *fastwire_shm_ring_close(PyObject *self, PyObject *args) {
+    PyObject *ring_obj;
+    if (!PyArg_ParseTuple(args, "O", &ring_obj)) return NULL;
+    if (!PyObject_TypeCheck(ring_obj, &ShmRing_Type)) {
+        PyErr_SetString(PyExc_TypeError, "expected a ShmRing");
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (!r->closed) {
+        if (r->creator && r->path[0]) unlink(r->path);
+        if (r->fd >= 0) {
+            close(r->fd);
+            r->fd = -1;
+        }
+        r->closed = 1;
+    }
+    Py_RETURN_NONE;
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
 /* ------------------------------------------------------------------ */
 
@@ -987,6 +1512,23 @@ static PyMethodDef fastwire_methods[] = {
     {"recv_into_nb", fastwire_recv_into_nb, METH_VARARGS,
      "recv_into_nb(fd, buffer) -> bytes read (0 would-block, -2 EOF, "
      "-errno error); drains a burst in one GIL window."},
+    {"shm_ring_create", fastwire_shm_ring_create, METH_VARARGS,
+     "shm_ring_create(name, capacity) -> ShmRing under /dev/shm."},
+    {"shm_ring_attach", fastwire_shm_ring_attach, METH_VARARGS,
+     "shm_ring_attach(name) -> ShmRing mapping an existing ring."},
+    {"shm_ring_push", fastwire_shm_ring_push, METH_VARARGS,
+     "shm_ring_push(ring, buffers) -> payload offset, or None when the "
+     "ring has no room (caller waits or falls back to the socket lane)."},
+    {"shm_ring_adopt", fastwire_shm_ring_adopt, METH_VARARGS,
+     "shm_ring_adopt(ring, offset, nbytes) -> ShmBuf zero-copy view; "
+     "its dealloc releases the chunk back to the creator."},
+    {"shm_ring_cancel", fastwire_shm_ring_cancel, METH_VARARGS,
+     "shm_ring_cancel(ring, offset): release an undelivered chunk."},
+    {"shm_ring_occupancy", fastwire_shm_ring_occupancy, METH_VARARGS,
+     "shm_ring_occupancy(ring) -> (used_bytes, capacity)."},
+    {"shm_ring_close", fastwire_shm_ring_close, METH_VARARGS,
+     "shm_ring_close(ring): unlink (creator) and refuse further ops; "
+     "live ShmBufs keep the mapping alive until they are dropped."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -1005,6 +1547,22 @@ PyMODINIT_FUNC PyInit__fastwire(void) {
     PooledBuf_Type.tp_new = NULL; /* C-internal construction only */
     if (PyType_Ready(&PooledBuf_Type) < 0) return NULL;
 
+    ShmRing_Type.tp_dealloc = ShmRing_dealloc;
+    ShmRing_Type.tp_flags = Py_TPFLAGS_DEFAULT;
+    ShmRing_Type.tp_doc = "Same-host shared-memory ring (/dev/shm file)";
+    ShmRing_Type.tp_new = NULL; /* C-internal construction only */
+    if (PyType_Ready(&ShmRing_Type) < 0) return NULL;
+
+    ShmBuf_Type.tp_dealloc = ShmBuf_dealloc;
+    ShmBuf_Type.tp_flags = Py_TPFLAGS_DEFAULT;
+    ShmBuf_Type.tp_doc =
+        "Adopted shm chunk payload (buffer protocol); dealloc releases "
+        "the chunk back to the ring's creator";
+    ShmBuf_Type.tp_as_buffer = &ShmBuf_as_buffer;
+    ShmBuf_Type.tp_as_sequence = &ShmBuf_as_sequence;
+    ShmBuf_Type.tp_new = NULL; /* C-internal construction only */
+    if (PyType_Ready(&ShmBuf_Type) < 0) return NULL;
+
     const char *cap_mb = getenv("FEDTPU_RECV_POOL_MB");
     if (cap_mb != NULL) {
         char *end = NULL;
@@ -1018,6 +1576,18 @@ PyMODINIT_FUNC PyInit__fastwire(void) {
     Py_INCREF(&PooledBuf_Type);
     if (PyModule_AddObject(m, "PooledBuf", (PyObject *)&PooledBuf_Type) < 0) {
         Py_DECREF(&PooledBuf_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&ShmRing_Type);
+    if (PyModule_AddObject(m, "ShmRing", (PyObject *)&ShmRing_Type) < 0) {
+        Py_DECREF(&ShmRing_Type);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&ShmBuf_Type);
+    if (PyModule_AddObject(m, "ShmBuf", (PyObject *)&ShmBuf_Type) < 0) {
+        Py_DECREF(&ShmBuf_Type);
         Py_DECREF(m);
         return NULL;
     }

@@ -47,6 +47,11 @@ CODE_OK = 200
 CODE_FORBIDDEN = 403  # peer cert does not attest the claimed src party
 CODE_PICKLE_FORBIDDEN = 415  # strict arrays-only mode rejected the frame
 CODE_JOB_MISMATCH = 417
+# Receiver could not attach/adopt a same-host shared-memory descriptor
+# (ring unlinked, cross-host misconfiguration, map failure). The sender
+# treats it as a per-push demotion signal: resend on the socket lane and
+# stop offering shm frames to this peer (proxy/lanes.py).
+CODE_SHM_UNAVAILABLE = 424
 CODE_INTERNAL_ERROR = 500
 
 # Seq id used by the ping_others readiness barrier for both the upstream

@@ -97,6 +97,13 @@ def reset_config_cache() -> None:
 # unset — parity with the reference's gRPC default (grpc_options.py:28-29).
 DEFAULT_MAX_MESSAGE_BYTES = 500 * 1024 * 1024
 
+# Canonical transport lane tiers, fastest first. The per-peer tier is
+# negotiated at connection setup by ``rayfed_tpu/proxy/lanes.py`` (the
+# single transport-selection point); ``cross_silo_comm.lane_tiers``
+# restricts/orders the tiers a deployment permits. Kept here (not in
+# lanes.py) so config validation needs no proxy import.
+LANE_TIERS = ("meshref", "shm", "tcp", "tls", "grpc")
+
 
 @dataclasses.dataclass
 class CrossSiloMessageConfig:
@@ -195,6 +202,38 @@ class CrossSiloMessageConfig:
     # parties in separate processes: the reference cannot resolve there
     # and the send fails loudly at decode.
     same_mesh_push: bool = False
+    # Transport lane-tier policy (docs/architecture.md "Lane tiers").
+    # ``lane_tiers`` restricts/orders the tiers this party may pick per
+    # peer; None (default) permits every tier in the canonical order
+    # ``LANE_TIERS`` = meshref > shm > tcp > tls > grpc. Negotiation
+    # (proxy/lanes.py) walks the list and picks the first tier whose
+    # predicate holds for the peer; failures demote one tier per push.
+    lane_tiers: Optional[List[str]] = None
+    # Same-host zero-copy shm lane (opt-in, like device_dma): bulk
+    # payloads to a peer on this host are written once into a /dev/shm
+    # ring and adopted zero-copy by the receiver; only a tiny descriptor
+    # frame (plus the ack) crosses the socket. Requires a plaintext
+    # same-host peer; every shm failure falls back to the socket lane
+    # per push, so enabling it can never lose a send.
+    shm_enabled: bool = False
+    # Per-peer ring capacity — the in-flight payload BUDGET, not just a
+    # buffer: adoption is zero-copy, so every received value the peer
+    # still holds pins its chunk. Size it to the peak pipelined payload
+    # volume (e.g. 5 concurrent 100MB pushes need >500MB). Bounds
+    # sender-side shm memory at ring_mb x peers; pushes that cannot fit
+    # wait up to ``shm_push_timeout_ms`` for the receiver to release
+    # space, then ride the socket lane.
+    shm_ring_mb: int = 256
+    # Payloads below this many bytes skip the shm lane: a descriptor
+    # frame + ring round-trip cannot beat the inline small-frame path.
+    shm_min_bytes: int = 64 * 1024
+    # How long a push may wait for ring space before falling back to
+    # the socket lane. Short on purpose: a full ring usually means the
+    # receiver is HOLDING earlier values (chunks pinned by live decoded
+    # views), and the socket delivers a 100MB payload in well under a
+    # second — stalling multiple seconds per push to avoid that is the
+    # pathological trade.
+    shm_push_timeout_ms: int = 250
     # Small-message fast path: payloads at or below this many bytes skip
     # the per-message fixed costs that dominate latency-bound rounds —
     # they ride the compact msgpack encoding (no tree walk for plain
@@ -208,6 +247,42 @@ class CrossSiloMessageConfig:
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False
+
+    def __post_init__(self):
+        if self.lane_tiers is not None:
+            tiers = tuple(self.lane_tiers)
+            unknown = [t for t in tiers if t not in LANE_TIERS]
+            if unknown:
+                raise ValueError(
+                    f"cross_silo_comm.lane_tiers contains unknown tiers "
+                    f"{unknown}; known tiers: {list(LANE_TIERS)}"
+                )
+            if len(set(tiers)) != len(tiers):
+                raise ValueError(
+                    f"cross_silo_comm.lane_tiers has duplicates: "
+                    f"{list(tiers)}"
+                )
+            if not tiers:
+                raise ValueError(
+                    "cross_silo_comm.lane_tiers must not be empty "
+                    "(omit it to permit every tier)"
+                )
+            self.lane_tiers = list(tiers)
+        if int(self.shm_ring_mb) < 1:
+            raise ValueError(
+                f"cross_silo_comm.shm_ring_mb must be >= 1, "
+                f"got {self.shm_ring_mb}"
+            )
+        if int(self.shm_min_bytes) < 0:
+            raise ValueError(
+                f"cross_silo_comm.shm_min_bytes must be >= 0, "
+                f"got {self.shm_min_bytes}"
+            )
+        if int(self.shm_push_timeout_ms) < 0:
+            raise ValueError(
+                f"cross_silo_comm.shm_push_timeout_ms must be >= 0, "
+                f"got {self.shm_push_timeout_ms}"
+            )
 
     def effective_max_message_bytes(self) -> Optional[int]:
         """The payload cap actually enforced on send and receive paths:

@@ -34,6 +34,7 @@ from typing import Callable, Dict, Optional, Type
 from rayfed_tpu._private.constants import PING_SEQ_ID
 from rayfed_tpu._private.global_context import get_global_context
 from rayfed_tpu.exceptions import FedRemoteError
+from rayfed_tpu.proxy import lanes
 from rayfed_tpu.proxy.base import (
     ReceiverProxy,
     SenderProxy,
@@ -208,27 +209,9 @@ def send_ping(dest_party: str) -> Future:
 
 
 def _default_transport_classes(transport: str):
-    if transport in ("tcp", "tpu"):
-        # 'tpu' layers device placement on arrival on top of the TCP wire;
-        # resolved lazily to keep jax out of control-plane-only processes.
-        if transport == "tpu":
-            from rayfed_tpu.proxy.tpu.tpu_proxy import (
-                TpuReceiverProxy,
-                TpuSenderProxy,
-            )
-
-            return TpuSenderProxy, TpuReceiverProxy
-        from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
-
-        return TcpSenderProxy, TcpReceiverProxy
-    if transport == "grpc":
-        from rayfed_tpu.proxy.grpc.grpc_proxy import (
-            GrpcReceiverProxy,
-            GrpcSenderProxy,
-        )
-
-        return GrpcSenderProxy, GrpcReceiverProxy
-    raise ValueError(f"unknown transport {transport!r}; use 'tcp', 'tpu' or 'grpc'")
+    # Back-compat shim: the proxy class table moved to proxy/lanes.py,
+    # the single transport-selection point.
+    return lanes.transport_proxy_classes(transport)
 
 
 def start_receiver_proxy(
@@ -484,7 +467,7 @@ def _capture_for_send(dest_party: str, data):
     dma_lane = False
     try:
         cfg = _sender_proxy.get_proxy_config(dest_party)
-        dma_lane = bool(getattr(cfg, "device_dma", False))
+        dma_lane = lanes.dma_enabled(cfg)
     except Exception:  # noqa: BLE001 - proxies without per-dest config
         pass
 

@@ -39,9 +39,19 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
+
+# gRPC-core logs WARNING-level config notes to stderr (among them
+# retry_service_config.cc's "Clamped retryPolicy.maxAttempts at 5", which
+# fires on every channel build even though our policy is pre-clamped —
+# see _channel_options). The env var is read at C-core init, so set it
+# before the first ``import grpc`` IN THIS PROCESS — spawned party
+# processes import this module directly and never see a bench driver's
+# env. setdefault: an operator's explicit verbosity choice wins.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
 
 import grpc
 
@@ -248,6 +258,42 @@ class GrpcSenderProxy(SenderProxy):
         raise RuntimeError(f"send rejected: code={code} {result}")
 
 
+def _restore_writable(value):
+    """Re-establish the receivers' writable-view promise on the pickle
+    lane. The native transports decode array leaves out of the recv
+    pool's bytearray (always writable, serialization.py's documented
+    contract), but pickle PRESERVES numpy's WRITEABLE=False flag — and
+    the sender's donation snapshot (_host_snapshot) stages single-device
+    jax leaves as read-only ``np.asarray`` host views. Without this,
+    the same payload arrives writable over tcp/tpu and read-only over
+    grpc, and a consumer's in-place update (``w -= lr * g``) dies with
+    ``ValueError('output array is read-only')`` on this lane only. The
+    unpickled array's base is itself read-only, so the flag cannot be
+    flipped in place — read-only leaves are copied."""
+    import numpy as np
+
+    from rayfed_tpu import tree_util
+
+    try:
+        leaves, spec = tree_util.tree_flatten(value)
+    except Exception:  # noqa: BLE001 - unflattenable payloads pass as-is
+        return value
+    changed = False
+    out = []
+    for x in leaves:
+        if isinstance(x, np.ndarray) and not x.flags.writeable:
+            out.append(np.array(x))
+            changed = True
+        else:
+            out.append(x)
+    if not changed:
+        return value
+    try:
+        return tree_util.tree_unflatten(out, spec)
+    except Exception:  # noqa: BLE001 - reconstruction must never drop data
+        return value
+
+
 class GrpcReceiverProxy(ReceiverProxy):
     def __init__(self, listen_addr, party, job_name, tls_config, proxy_config=None):
         super().__init__(listen_addr, party, job_name, tls_config, proxy_config)
@@ -255,7 +301,7 @@ class GrpcReceiverProxy(ReceiverProxy):
         allowed = self._config.serializing_allowed_list
 
         def decode(header, payload):
-            return restricted_loads(bytes(payload), allowed)
+            return _restore_writable(restricted_loads(bytes(payload), allowed))
 
         recv_timeout = self._config.recv_timeout_in_ms
         self._store = RendezvousStore(

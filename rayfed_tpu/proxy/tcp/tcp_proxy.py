@@ -58,10 +58,11 @@ from rayfed_tpu._private.constants import (
     CODE_FORBIDDEN,
     CODE_INTERNAL_ERROR,
     CODE_OK,
+    CODE_SHM_UNAVAILABLE,
 )
 from rayfed_tpu.config import TcpCrossSiloMessageConfig
 from rayfed_tpu.exceptions import FedLocalError
-from rayfed_tpu.proxy import rendezvous
+from rayfed_tpu.proxy import lanes, rendezvous
 from rayfed_tpu.proxy.base import (
     ReceiverProxy,
     SenderProxy,
@@ -77,13 +78,9 @@ logger = logging.getLogger(__name__)
 
 
 def _reactor_mode(cfg, tls_config) -> bool:
-    """Plaintext connections ride the shared epoll reactor when the
-    platform has one; TLS keeps the threaded half-duplex path."""
-    return (
-        not wire.tls_enabled(tls_config)
-        and getattr(cfg, "use_reactor", True)
-        and reactor_mod.available()
-    )
+    """Back-compat shim: the decision moved to proxy/lanes.py, the
+    single transport-selection point."""
+    return lanes.reactor_mode(cfg, tls_config)
 
 
 class _ConnectExhausted(Exception):
@@ -123,6 +120,23 @@ class _DestWorker(threading.Thread):
         self._small_threshold = max(
             0, getattr(self._cfg, "small_message_threshold", 0) or 0
         )
+        # One transport-selection point: lanes.py negotiates this peer's
+        # tier from the capability snapshot (proxy/lanes.py). The overlay
+        # tiers (meshref/shm) keep the socket lane underneath for control
+        # frames, descriptor frames and per-push fallback.
+        self._lane_decision = lanes.negotiate_for_dest(
+            self._cfg,
+            proxy._tls_config,
+            proxy._TRANSPORT,
+            self_addr=proxy._addresses.get(proxy._party),
+            dest_addr=proxy._addresses.get(dest_party),
+        )
+        lanes.set_peer_tier(dest_party, self._lane_decision.tier)
+        self._shm: Optional[lanes.ShmSender] = None
+        if self._lane_decision.tier == "shm":
+            self._shm = lanes.ShmSender(
+                proxy._job_name, proxy._party, dest_party, self._cfg
+            )
         use_reactor = _reactor_mode(self._cfg, proxy._tls_config)
         if not wire.tls_enabled(proxy._tls_config):
             # Plaintext connections pipeline frames (window of unacked
@@ -163,8 +177,10 @@ class _DestWorker(threading.Thread):
                 self._lanes = [self._lane]
         # The device-DMA lane's register step is not vetted for arbitrary
         # submitter threads, so it keeps the serialized worker.
-        self._threaded = self._lane is None or not use_reactor or bool(
-            getattr(self._cfg, "device_dma", False)
+        self._threaded = (
+            self._lane is None
+            or not use_reactor
+            or lanes.dma_enabled(self._cfg)
         )
         if self._threaded:
             self.start()
@@ -201,9 +217,11 @@ class _DestWorker(threading.Thread):
         self._attach_done_callbacks(
             out, on_done, payload_len, upstream_seq_id, downstream_seq_id
         )
-        if self._try_submit_striped(out, header, buffers, payload_len):
+        if on_done is None and self._try_submit_shm(
+            out, header, buffers, payload_len
+        ):
             return
-        self._lane.submit(out, header, buffers, payload_len)
+        self._submit_socket(out, header, buffers, payload_len)
 
     def _try_submit_striped(self, out, header, buffers, payload_len) -> bool:
         """Stripe one large multi-buffer tree payload across all lanes.
@@ -262,8 +280,75 @@ class _DestWorker(threading.Thread):
             self._lanes[i % len(self._lanes)].submit(part, h, bufs, nbytes)
         return True
 
+    def _submit_socket(self, out, header, buffers, payload_len) -> None:
+        """The socket tiers: striped across lanes when that wins, the
+        ordered lane 0 otherwise."""
+        if self._try_submit_striped(out, header, buffers, payload_len):
+            return
+        self._lane.submit(out, header, buffers, payload_len)
+
+    def _try_submit_shm(self, out, header, buffers, payload_len) -> bool:
+        """Divert one bulk frame to the same-host shm ring: payload bytes
+        land in /dev/shm and only a tiny descriptor frame crosses the
+        socket lane, so the ack/resend/peer-down machinery is reused
+        unchanged. Returns False to fall through to the socket tiers.
+        Every failure after the push falls back per push — cancel the
+        chunk, resend the original frame on the socket — so a send is
+        never lost; a peer NACK with code 424 (cannot attach or adopt)
+        additionally demotes this peer for the rest of the job."""
+        shm = self._shm
+        if shm is None or not shm.eligible(header, payload_len):
+            return False
+        pushed = shm.push(buffers, payload_len)
+        if pushed is None:
+            # Ring saturated or create failed: this push rides the
+            # socket; later pushes try the ring again unless broken.
+            lanes.record_fallback("shm", "tcp")
+            return False
+        name, off = pushed
+        desc = lanes.encode_shm_descriptor(name, off, payload_len, header)
+        dheader = dict(header)
+        dheader["pkind"] = "shm"
+        dheader["pmeta"] = b""
+
+        inner: Future = Future()
+
+        def _on_desc(f: Future) -> None:
+            err = f.exception()
+            if err is None and f.result() is True:
+                lanes.record_lane_send("shm")
+                try:
+                    out.set_result(True)
+                except InvalidStateError:
+                    pass
+                return
+            shm.cancel(off)
+            if err is not None and (
+                f"code={CODE_SHM_UNAVAILABLE}" in str(err)
+            ):
+                shm.mark_broken()
+                lanes.set_peer_tier(self._dest, "tcp")
+                logger.warning(
+                    "peer %s cannot adopt shm frames (%s); demoted to "
+                    "the socket lane for the rest of the job",
+                    self._dest, err,
+                )
+            lanes.record_fallback("shm", "tcp")
+            try:
+                self._submit_socket(out, header, buffers, payload_len)
+            except BaseException as e:  # noqa: BLE001 - resolve the send
+                if not out.done():
+                    out.set_exception(e)
+
+        inner.add_done_callback(_on_desc)
+        self._lane.submit(inner, dheader, [desc], len(desc))
+        return True
+
     def close(self) -> None:
         self._closed = True
+        if self._shm is not None:
+            self._shm.close()
+        lanes.clear_peer_tier(self._dest)
         if self._threaded:
             self._jobs.put(None)
         for lane in self._lanes or ():
@@ -375,6 +460,10 @@ class _DestWorker(threading.Thread):
                 downstream_seq_id,
             )
             if self._lane is not None:
+                if on_done is None and self._try_submit_shm(
+                    out, header, buffers, payload_len
+                ):
+                    continue
                 self._lane.submit(out, header, buffers, payload_len)
                 continue
             try:
@@ -431,7 +520,7 @@ class _DestWorker(threading.Thread):
             or thr <= 0
             or self._closed
             or is_error
-            or getattr(self._cfg, "device_dma", False)
+            or lanes.dma_enabled(self._cfg)
         ):
             return False
         if isinstance(data, Future) and not data.done():
@@ -726,14 +815,20 @@ class TcpReceiverProxy(ReceiverProxy):
             recv_timeout_s=None if recv_timeout is None else recv_timeout / 1000,
             allow_pickle=self._config.allow_pickle_payloads,
         )
-        # Multi-stream senders split bulk payloads into stripe frames
-        # that arrive interleaved over K connections; the assembler
-        # buffers and re-offers them whole. Non-stripe traffic passes
-        # through untouched.
-        self._offer = rendezvous.StripeAssembler(
-            self._store.offer,
-            max_payload_bytes=self._config.effective_max_message_bytes(),
-        ).offer
+        # Offer chain, outermost first: the shm adopter resolves
+        # same-host descriptor frames into ring bytes (zero-copy with
+        # the native ring) — adoption runs pre-ack, so a failure NACKs
+        # 424 and the sender falls back to the socket lane mid-job
+        # (proxy/lanes.py). Then the stripe assembler re-assembles bulk
+        # payloads that multi-stream senders split across K connections.
+        # Everything else passes through untouched.
+        self._shm_adopter = lanes.ShmAdopter(
+            rendezvous.StripeAssembler(
+                self._store.offer,
+                max_payload_bytes=self._config.effective_max_message_bytes(),
+            ).offer
+        )
+        self._offer = self._shm_adopter.offer
         self._listener: Optional[socket.socket] = None
         self._ready_result = None
         self._open_conns: set = set()
@@ -819,6 +914,7 @@ class TcpReceiverProxy(ReceiverProxy):
         if self._reactors is not None:
             self._reactors = None
             reactor_mod.release_reactors()
+        self._shm_adopter.close()
         self._store.shutdown()
         # A burst of large frames must not pin pool memory past the job.
         sockio.trim_recv_pool()

@@ -35,7 +35,7 @@ import logging
 import threading
 from collections import OrderedDict
 
-from rayfed_tpu.proxy import rendezvous
+from rayfed_tpu.proxy import lanes, rendezvous
 from rayfed_tpu.proxy.tcp.tcp_proxy import TcpReceiverProxy, TcpSenderProxy
 
 logger = logging.getLogger(__name__)
@@ -147,11 +147,11 @@ class TpuSenderProxy(TcpSenderProxy):
                             dest_party=None):
         if is_error:
             return None
-        if getattr(cfg, "same_mesh_push", False):
+        if lanes.meshref_enabled(cfg):
             posted = _try_post_same_mesh(value, dest_party)
             if posted is not None:
                 return posted
-        if not getattr(cfg, "device_dma", False):
+        if not lanes.dma_enabled(cfg):
             return None
         from rayfed_tpu.proxy.tpu import dma
 
@@ -299,7 +299,7 @@ class TpuReceiverProxy(TcpReceiverProxy):
             self._config.serializing_allowed_list,
             allow_pickle=self._config.allow_pickle_payloads,
             max_decompressed_bytes=self._config.effective_max_message_bytes(),
-            device_dma=getattr(self._config, "device_dma", False),
+            device_dma=lanes.dma_enabled(self._config),
             dma_listen_addr=getattr(
                 self._config, "dma_listen_addr", "127.0.0.1:0"
             ),

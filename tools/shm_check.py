@@ -1,0 +1,138 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Shared-memory lane regression gate: shm push vs loopback TCP.
+
+Runs bench.py's 2-party TCP-transport push (real spawned parties, real
+sockets) twice — once plain, once with ``shm_enabled`` so the payload
+bytes ride the /dev/shm ring and only descriptor frames cross the
+socket — and FAILS LOUDLY (exit 1) when the shm lane no longer beats
+loopback TCP by the required ratio. The shm lane exists to delete the
+socket's copy chain (sender writev + kernel + receiver readv) for
+same-host peers; a change that quietly re-adds a staging copy, breaks
+ring adoption (every push falling back to the socket makes the two
+stages measure the SAME lane), or serializes pushes behind the ring
+lock turns the build red.
+
+Gating is on the MAX-of-reps of both lanes ("can the code still go
+this fast"). Two anti-gaming guards:
+
+- an ABSOLUTE floor on the shm lane (``FEDTPU_SHM_FLOOR_GBPS``) so the
+  ratio cannot be met by regressing the TCP baseline;
+- a sanity floor on the TCP baseline itself — a near-zero denominator
+  means the harness, not the lane, is broken.
+
+Knobs:
+
+  FEDTPU_SHM_RATIO          default 4.0 — required shm/tcp throughput
+                            ratio (acceptance bar; measured 4.0-4.6x
+                            on the 1-core CI host class where loopback
+                            TCP maxes ~1.55 GB/s and the shm lane
+                            ~6.5-7 GB/s).
+  FEDTPU_SHM_FLOOR_GBPS     default 3.0 — absolute shm-lane floor.
+  FEDTPU_SHM_WALL_BUDGET_S  default 600 — hard cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    ratio_budget = float(os.environ.get("FEDTPU_SHM_RATIO", "4.0"))
+    floor_gbps = float(os.environ.get("FEDTPU_SHM_FLOOR_GBPS", "3.0"))
+    wall_budget_s = float(os.environ.get("FEDTPU_SHM_WALL_BUDGET_S", "600"))
+    t0 = time.monotonic()
+
+    with bench._cpu_forced():
+        tcp = bench.run_transport("tcp")
+        print(
+            f"tcp loopback: max={tcp['max']:.3f} GB/s "
+            f"median={tcp['median']:.3f}",
+            flush=True,
+        )
+        if time.monotonic() - t0 > wall_budget_s:
+            print(
+                f"SHM GATE WALL-CLOCK BREACH: the tcp stage alone ate the "
+                f"{wall_budget_s:.0f}s budget — a hung party or stuck "
+                f"dial, not just a slow host.",
+                file=sys.stderr,
+            )
+            return 1
+        shm = bench.run_transport("tcp", shm=True)
+        print(
+            f"shm lane: max={shm['max']:.3f} GB/s "
+            f"median={shm['median']:.3f}",
+            flush=True,
+        )
+
+    if time.monotonic() - t0 > wall_budget_s:
+        print(
+            f"SHM GATE WALL-CLOCK BREACH: {time.monotonic() - t0:.0f}s "
+            f"elapsed exceeds the {wall_budget_s:.0f}s budget.",
+            file=sys.stderr,
+        )
+        return 1
+
+    if tcp["max"] <= 0.05:
+        print(
+            f"SHM GATE BASELINE BROKEN: tcp_loopback_gbps "
+            f"{tcp['max']:.3f} is implausibly low — the harness (spawn, "
+            f"dial, payload sizing) is broken; a ratio against a dead "
+            f"baseline proves nothing.",
+            file=sys.stderr,
+        )
+        return 1
+    if shm["max"] < floor_gbps:
+        print(
+            f"SHM LANE REGRESSION: shm_push_gbps {shm['max']:.3f} is "
+            f"below the absolute floor {floor_gbps:.1f} GB/s. The ratio "
+            f"gate cannot be satisfied by a slower TCP baseline — this "
+            f"floor is the anti-gaming guard. Check that pushes are "
+            f"actually adopted from the ring "
+            f"(fed_transport_lane_send_ops_total{{lane=\"shm\"}} should "
+            f"grow, fallbacks should not) and that the native shm_copy "
+            f"path (NT stores) is still built.",
+            file=sys.stderr,
+        )
+        return 1
+
+    ratio = shm["max"] / tcp["max"]
+    print(f"shm/tcp ratio {ratio:.2f} (budget {ratio_budget:.2f})")
+    if ratio < ratio_budget:
+        print(
+            f"SHM LANE REGRESSION: shm_push_gbps {shm['max']:.3f} is only "
+            f"{ratio:.2f}x tcp_loopback_gbps {tcp['max']:.3f} (budget "
+            f"{ratio_budget:.2f}x). The usual suspects: every push "
+            f"falling back to the socket lane (negotiation no longer "
+            f"picks shm for 127.0.0.1, or eligibility rejects the bench "
+            f"payload), a re-added copy between serialize and ring, or "
+            f"adoption NACKs demoting the peer after the first push.",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"shm gate passed in {time.monotonic() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
