@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma shm serve async churn obs clean
+.PHONY: native test lint chaos latency scale dma shm serve async churn obs privacy clean
 
 native:
 	python setup.py build_ext --inplace
@@ -99,6 +99,17 @@ churn:
 obs:
 	JAX_PLATFORMS=cpu python tools/obs_check.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
+
+# Privacy gate (docs/privacy.md): 3 spawned parties with the privacy
+# plane on — paired plaintext/secure FedAvg windows, every secure round
+# bitwise-checked against the plaintext fold (mask cancellation is
+# EXACT or broken, never "close"), secure_agg_overhead_pct under
+# FEDTPU_SECAGG_BUDGET_PCT, the int8 quantized push over its floor,
+# plus the privacy unit/chaos tests. Mirrors the `privacy` job in
+# .github/workflows/tests.yml.
+privacy:
+	JAX_PLATFORMS=cpu python tools/privacy_check.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_privacy.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -1912,6 +1912,152 @@ def _obs_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_SECAGG3 = ("alice", "bob", "carol")
+
+
+def _secagg_party(party, addresses, transport, result_path, rounds):
+    """3-party privacy-plane stage (docs/privacy.md): paired plaintext /
+    secure windows of the same integer-valued FedAvg round price the
+    masking path (fixed-point encode + pairwise PRNG streams at each
+    party, ring unmask at the root) — ``secure_agg_overhead_pct`` is the
+    median over the pairs. Every secure round is also bitwise-compared
+    against the locally recomputed plaintext fold
+    (``secagg_bitwise_equal``: the mask-cancellation witness
+    tools/privacy_check.py gates). A final window owner-pushes int8
+    error-feedback-quantized trees across the wire and prices them in
+    ORIGINAL float bytes per second: ``quantized_push_gbps``."""
+    import statistics
+
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import topology as topo
+    from rayfed_tpu.federated import fed_aggregate
+    from rayfed_tpu.ops.aggregate import reduce_by_plan
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": dict(_FAST_RETRY),
+            "transport": transport,
+            "privacy": {"secure_aggregation": True, "mask_seed": 97},
+        },
+        job_name=f"bench-secagg-{transport}",
+        logging_level="error",
+    )
+
+    leafs = 8192
+
+    def local_tree(seed, r):
+        rng = np.random.default_rng(seed * 1000 + r)
+        return {"w": rng.integers(-1000, 1000, (leafs,)).astype(np.float32)}
+
+    @fed.remote
+    def contrib(seed, r):
+        return local_tree(seed, r)
+
+    seeds = {p: i + 1 for i, p in enumerate(_SECAGG3)}
+    plan = topo.plan(list(_SECAGG3), "flat")
+    bitwise_ok = [True]
+
+    def window(n, secure):
+        # Median per-round ms (one GC pause must not swamp the few-
+        # percent masking cost); every party fed.gets the aggregate, so
+        # the fetch doubles as the round barrier in both windows.
+        times = []
+        for r in range(n):
+            t0 = time.perf_counter()
+            objs = {
+                p: contrib.party(p).remote(seeds[p], r) for p in _SECAGG3
+            }
+            val = fed.get(fed_aggregate(objs, op="mean", secure=secure))
+            times.append((time.perf_counter() - t0) * 1000.0)
+            if secure and party == "alice":
+                expect = reduce_by_plan(
+                    plan, {p: local_tree(seeds[p], r) for p in _SECAGG3}
+                )
+                if np.asarray(val["w"]).tobytes() != \
+                        np.asarray(expect["w"]).tobytes():
+                    bitwise_ok[0] = False
+        return statistics.median(times)
+
+    _progress(party, "warmup")
+    window(max(2, rounds // 4), secure=False)
+    window(max(2, rounds // 4), secure=True)  # seed exchange + jit
+
+    # 5 pairs, alternating plain-first / secure-first so a monotone host
+    # drift biases half the pairs each way and the median cancels it.
+    plain_ms, secure_ms = [], []
+    for i in range(5):
+        _progress(party, f"pair {i}")
+        if i % 2 == 0:
+            plain_ms.append(window(rounds, secure=False))
+            secure_ms.append(window(rounds, secure=True))
+        else:
+            secure_ms.append(window(rounds, secure=True))
+            plain_ms.append(window(rounds, secure=False))
+
+    # Quantized-push window: int8 error-feedback trees cross the wire
+    # (1/4 the bytes), priced in original float bytes per second.
+    _progress(party, "quantized push window")
+    push_mb = 32
+    push_reps = 4
+
+    @fed.remote
+    def make_packed(r):
+        n = push_mb * (1 << 20) // 4
+        rng = np.random.default_rng(r)
+        tree = {"w": rng.standard_normal(n).astype(np.float32)}
+        return _secagg_quantizer().quantize("alice", tree)
+
+    @fed.remote
+    def sink(packed):
+        from rayfed_tpu.privacy.quantize import dequantize_tree
+
+        t = dequantize_tree(packed)
+        return float(np.asarray(t["w"]).flat[0])
+
+    fed.get(sink.party("bob").remote(make_packed.party("alice").remote(0)))
+    t0 = time.perf_counter()
+    for r in range(push_reps):
+        fed.get(
+            sink.party("bob").remote(make_packed.party("alice").remote(r + 1))
+        )
+    dt = time.perf_counter() - t0
+    quant_gbps = push_reps * push_mb * (1 << 20) / dt / 1e9
+
+    if party == "alice":
+        overhead = statistics.median(
+            (s - p) / p * 100.0 for p, s in zip(plain_ms, secure_ms)
+        )
+        with open(result_path, "w") as f:
+            json.dump({
+                "secure_agg_overhead_pct": overhead,
+                "secagg_bitwise_equal": int(bitwise_ok[0]),
+                "quantized_push_gbps": quant_gbps,
+                "plain_round_ms": plain_ms,
+                "secure_round_ms": secure_ms,
+            }, f)
+    fed.shutdown()
+
+
+# Executor-process singleton for the quantized-push window: the error-
+# feedback residual must persist ACROSS make_packed tasks (that is the
+# contract being priced), so it cannot live inside the task closure.
+# Built lazily — bench.py must stay importable without rayfed_tpu.
+_secagg_ef = None
+
+
+def _secagg_quantizer():
+    global _secagg_ef
+    if _secagg_ef is None:
+        from rayfed_tpu.privacy.quantize import ErrorFeedbackQuantizer
+
+        _secagg_ef = ErrorFeedbackQuantizer()
+    return _secagg_ef
+
+
 def _try_build_fastwire() -> None:
     """Best-effort build of the native C++ IO lane; the transport falls
     back to pure-Python sockets if this fails."""
@@ -2210,6 +2356,23 @@ def main() -> None:
         extra_fields={
             "fleet_scrape_ms": "fleet_scrape_ms",
             "obs_stitched": "obs_stitched",
+        },
+    ))
+    # Privacy plane (docs/privacy.md): paired plaintext/secure FedAvg
+    # windows price the masking path, every secure round is bitwise-
+    # checked against the plaintext fold, and a quantized-push window
+    # prices int8 error-feedback trees on the wire.
+    # tools/privacy_check.py gates all three.
+    result.update(_bench_stage(
+        _secagg_party, "secure_agg_overhead_pct",
+        "FEDTPU_BENCH_SECAGG_ROUNDS", 20,
+        [("tcp", "secure_agg_overhead_pct")], cpu_force=True,
+        parties=_SECAGG3, timeout_s=420,
+        extra_fields={
+            "secagg_bitwise_equal": "secagg_bitwise_equal",
+            "quantized_push_gbps": "quantized_push_gbps",
+            "plain_round_ms": "secagg_plain_round_ms",
+            "secure_round_ms": "secagg_secure_round_ms",
         },
     ))
     # N-party scale sweep (in-process simulated parties, real wire edges).

@@ -32,6 +32,7 @@ from rayfed_tpu.api import (  # noqa: F401
     leave,
     membership_sync,
     membership_view,
+    privacy_ledger,
     remote,
     shutdown,
 )
@@ -79,6 +80,7 @@ __all__ = [
     "leave",
     "membership_sync",
     "membership_view",
+    "privacy_ledger",
     "serve",
     "submit_request",
     "ServeHandle",

@@ -458,12 +458,17 @@ def _spec_from_wire(w: dict) -> tree_util.TreeSpec:
 # Lossy wire precision (config ``payload_wire_dtype``): accepted knob
 # values -> canonical numpy dtype names. bf16 keeps float32's exponent
 # range (safe for gradients); fp16 halves mantissa error but overflows
-# past 65504 — callers pick their poison explicitly.
+# past 65504 — callers pick their poison explicitly. int8 is the privacy
+# plane's quantized tier (4x fewer bulk bytes than fp32): symmetric
+# per-leaf uniform quantization, the scale rides the leaf descriptor
+# (``qs``) — gated at fed.init on config["privacy"]["quantize"]="int8"
+# (privacy/config.validate_wire_dtype_gate).
 WIRE_DTYPES = {
     "bf16": "bfloat16",
     "bfloat16": "bfloat16",
     "fp16": "float16",
     "float16": "float16",
+    "int8": "int8",
 }
 
 
@@ -524,13 +529,35 @@ def try_encode_tree(
                 # shipped raw would decode to garbage values.
                 arr = arr.astype(arr.dtype.newbyteorder("="))
             odt = None
+            qscale = None
             if (
                 wire_dtype is not None
                 and arr.dtype.kind == "f"
                 and arr.dtype.itemsize > 2
             ):
                 odt = arr.dtype.name
-                arr = arr.astype(_np_dtype(wire_dtype))
+                if wire_dtype == "int8":
+                    # Quantized tier: symmetric per-leaf int8, scale in
+                    # the descriptor. The savings counter feeds the
+                    # privacy plane's telemetry (lazy import: the wire
+                    # path must work even in processes that never
+                    # touched the privacy package).
+                    from rayfed_tpu.privacy.quantize import quantize_leaf
+
+                    saved = arr.nbytes
+                    arr, qscale = quantize_leaf(arr)
+                    saved -= arr.nbytes
+                    if saved > 0:
+                        try:
+                            from rayfed_tpu.privacy.manager import (
+                                record_quantized_bytes_saved,
+                            )
+
+                            record_quantized_bytes_saved(saved)
+                        except Exception:  # noqa: BLE001 - stats only
+                            pass
+                else:
+                    arr = arr.astype(_np_dtype(wire_dtype))
             if not arr.flags["C_CONTIGUOUS"]:
                 arr = np.ascontiguousarray(arr)
             buf = _array_buffer(arr)
@@ -543,6 +570,8 @@ def try_encode_tree(
             }
             if odt is not None:
                 desc["odt"] = odt
+            if qscale is not None:
+                desc["qs"] = float(qscale)
             descs.append(desc)
             buffers.append(buf)
             offset += arr.nbytes
@@ -660,7 +689,15 @@ def decode_tree(meta: dict, payload, sharded_fn=None) -> Any:
             raw = payload_range(payload, d["off"], d["n"])
             arr = np.frombuffer(raw, dtype=dtype).reshape(d["shape"])
             odt = d.get("odt")
-            if odt:
+            qs = d.get("qs")
+            if qs is not None:
+                # Quantized-tier leaf: dequantize through the shipped
+                # per-leaf scale back to the producer's dtype (values
+                # carry the int8 grid's rounding).
+                arr = (arr.astype(np.float64) * qs).astype(
+                    _np_dtype(odt or "float32")
+                )
+            elif odt:
                 # Lossy-wire leaf: restore the producer's dtype so the
                 # consumer sees the type it sent (values carry the
                 # wire dtype's rounding).

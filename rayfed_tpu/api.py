@@ -66,6 +66,7 @@ logger = logging.getLogger(__name__)
 FEDLINT_ANCHORS = {
     "get": ("FED001", "FED002"),  # owner-push perimeter; seq-consistent gets
     "remote": ("FED002", "FED004"),  # identical call sequence; consumed edges
+    "aggregate": ("FED006",),  # privacy plane on -> aggregate securely
 }
 
 original_sigint = signal.getsignal(signal.SIGINT)
@@ -104,7 +105,11 @@ def init(
             :class:`~rayfed_tpu.config.TcpCrossSiloMessageConfig`),
             ``barrier_on_initializing`` (bool: block until all parties are
             reachable), ``party_mesh`` (TPU device topology for this party,
-            see :class:`~rayfed_tpu.config.PartyMeshConfig`).
+            see :class:`~rayfed_tpu.config.PartyMeshConfig`), ``privacy``
+            (secure aggregation / DP / quantized pushes, see
+            :class:`~rayfed_tpu.privacy.PrivacyConfig` and
+            docs/privacy.md; keys are validated strictly — a typo
+            rejects init).
         tls_config: ``{ca_cert, cert, key}`` file paths for mutual TLS.
         logging_level: root logging level.
         sender_proxy_cls / receiver_proxy_cls: custom transport classes
@@ -136,7 +141,21 @@ def init(
     cross_silo_comm_config = CrossSiloMessageConfig.from_dict(cross_silo_comm_dict)
 
     # Validate transport-dependent config BEFORE any state is built, so a
-    # rejected init leaves nothing behind.
+    # rejected init leaves nothing behind. The privacy block is STRICT:
+    # a typo'd privacy.* key rejects init (a job must not silently run
+    # without the protection it asked for), and the int8 wire tier is
+    # refused unless the privacy plane's quantizer is on.
+    privacy_dict = config.get("privacy")
+    privacy_cfg = None
+    if privacy_dict is not None:
+        from rayfed_tpu.privacy.config import PrivacyConfig
+
+        privacy_cfg = PrivacyConfig.from_dict(privacy_dict)
+    from rayfed_tpu.privacy.config import validate_wire_dtype_gate
+
+    validate_wire_dtype_gate(
+        cross_silo_comm_dict.get("payload_wire_dtype"), privacy_dict
+    )
     transport = transport or config.get("transport", "tcp")
     if (
         transport == "grpc"
@@ -385,6 +404,17 @@ def init(
         membership_manager.install()
         set_membership_manager(membership_manager)
 
+    # Privacy plane (docs/privacy.md): the manager owns the pairwise
+    # seed store and the ``prv:`` control handler, the DP ledger, and
+    # the error-feedback quantizer. AFTER membership (dropout recovery
+    # consults the roster) and BEFORE telemetry (the collector's first
+    # scrape sees the fed_privacy_* series registered). Leader-only,
+    # like the control handlers it registers.
+    if privacy_cfg is not None and party_process_id == 0:
+        from rayfed_tpu.privacy.manager import install_privacy
+
+        install_privacy(job_name, party, privacy_cfg)
+
     # Telemetry plane (docs/observability.md): per-party metrics agent +
     # the collector/HTTP endpoint at the collector party. AFTER the
     # membership block so the collector's fleet view can consult the
@@ -472,6 +502,15 @@ def _shutdown(intended: bool = True):
     _membership = sys.modules.get("rayfed_tpu.membership.manager")
     if _membership is not None:
         _membership.clear_membership_manager()
+    # Privacy plane: unregister the prv: control handler while the
+    # rendezvous store is still up, and drop seeds/ledger — a new job
+    # must not aggregate under an old job's masks or epsilon budget.
+    _privacy = sys.modules.get("rayfed_tpu.privacy.manager")
+    if _privacy is not None:
+        try:
+            _privacy.uninstall_privacy()
+        except Exception:  # noqa: BLE001 - must not block teardown
+            logger.warning("privacy-plane teardown failed", exc_info=True)
     internal_kv.kv_reset()
     clear_global_context(wait_for_sending=wait_for_sending)
     from rayfed_tpu import topology as _topology
@@ -623,6 +662,20 @@ def membership_view():
 
     manager = _mbr_manager.get_membership_manager()
     return None if manager is None else manager.view()
+
+
+def privacy_ledger() -> Dict[str, Dict[str, float]]:
+    """The DP ledger THIS process has accumulated: ``{party:
+    {"epsilon", "delta", "rounds"}}`` for every party charged by a noisy
+    secure aggregation this session (docs/privacy.md). Epsilon accrues
+    at the aggregation ROOT (where the noise is added); other parties see
+    it through the ``fed_privacy_ledger_epsilon`` telemetry gauge. Empty
+    when the privacy plane is off, ``noise_multiplier`` is unset, or no
+    noisy round has folded yet."""
+    from rayfed_tpu.privacy.manager import get_privacy_manager
+
+    manager = get_privacy_manager()
+    return {} if manager is None else manager.ledger_snapshot()
 
 
 def _get_addresses(job_name: str) -> Dict[str, str]:

@@ -578,3 +578,7 @@ class ServingConfig:
 # historically spelled ``rayfed_tpu.config.<Name>`` (same pattern as
 # RetryPolicy above).
 from rayfed_tpu.membership.config import MembershipConfig  # noqa: E402,F401
+
+# PrivacyConfig lives with the privacy plane (privacy/config.py);
+# re-exported for the same reason.
+from rayfed_tpu.privacy.config import PrivacyConfig  # noqa: E402,F401

@@ -106,6 +106,86 @@ def _agg_psum_flat(parties, weights, *trees):
     return psum_by_plan(plan, contributions, weights=weights)
 
 
+@fed.remote
+def _secagg_mask(tree, party, parties, domain, round_index, weight):
+    # Party-side secure step: clip (DP), premultiply (wmean), encode into
+    # the fixed-point ring, mask against every co-contributor. Executes
+    # AT the contributing party — only the masked envelope rides the wire.
+    from rayfed_tpu.privacy.manager import require_privacy_manager
+
+    mgr = require_privacy_manager("fed_aggregate(secure=True)")
+    return mgr.mask_contribution(
+        tree, party=party, parties=list(parties), domain=domain,
+        round_index=round_index, weight=weight,
+    )
+
+
+@fed.remote
+def _secagg_reduce(op, parties, domain, round_index, weights, *envelopes):
+    # Root-side secure step: ring-sum the masked envelopes (host fold, or
+    # ONE party-axis collective when this process holds a composed mesh
+    # for the contributors — bitwise-identical by modular associativity),
+    # cancel orphaned masks of dropped parties, decode, scale, add DP
+    # noise. The envelopes of parties that died before contributing
+    # arrive as None (their FedObject never resolved at the caller).
+    from rayfed_tpu.privacy.manager import require_privacy_manager
+
+    mgr = require_privacy_manager("fed_aggregate(secure=True)")
+    envs = {
+        e["party"]: e
+        for e in envelopes
+        if isinstance(e, dict) and e.get("__secagg__")
+    }
+    return mgr.secure_reduce(
+        op, list(parties), domain, round_index, weights, envs
+    )
+
+
+# Secure rounds are numbered per aggregation domain by a driver-local
+# counter. Every controller calls fed_aggregate in the same order with
+# the same arguments (the multi-controller contract), so every driver —
+# and therefore every party's masking task — derives the same round
+# index without any extra coordination.
+_secure_round_counters: Dict[str, int] = {}
+
+SECURE_SYNC_DOMAIN = "fedagg"
+
+
+def _next_secure_round(domain: str) -> int:
+    rnd = _secure_round_counters.get(domain, 0)
+    _secure_round_counters[domain] = rnd + 1
+    return rnd
+
+
+def _reset_secure_rounds() -> None:
+    _secure_round_counters.clear()
+
+
+def _secure_sync_aggregate(plan, objs, op, weights, publish_to):
+    """The secure=True sync lowering: one masking task per party, one
+    unmask-by-cancellation reduce at the root. Always single-hop — a
+    masked envelope is only unmaskable once ALL contributions meet, so
+    intermediate tree/ring hops would see nothing but could compute
+    nothing either."""
+    rnd = _next_secure_round(SECURE_SYNC_DOMAIN)
+    w = None
+    if op == "wmean":
+        w = {p: float(weights[p]) for p in plan.parties}
+    masked = [
+        _secagg_mask.party(p).remote(
+            objs[p], p, tuple(plan.parties), SECURE_SYNC_DOMAIN, rnd,
+            None if w is None else w[p],
+        )
+        for p in plan.parties
+    ]
+    root = _secagg_reduce.party(plan.root).remote(
+        op, tuple(plan.parties), SECURE_SYNC_DOMAIN, rnd, w, *masked
+    )
+    if publish_to is not None:
+        publish_to.publish(root)
+    return root
+
+
 def _try_same_mesh_aggregate(plan, objs, op, weights):
     """Lower a flat plan to a single-psum task at the root when every
     party resolves onto one registered composed mesh. Returns the result
@@ -138,6 +218,7 @@ def fed_aggregate(
     buffer_k: Optional[int] = None,
     staleness_fn: Optional[str] = None,
     round_tag: Optional[int] = None,
+    secure: bool = False,
 ) -> Any:
     """Reduce ``{party: FedObject-of-pytree}`` along a planned topology.
 
@@ -176,8 +257,47 @@ def fed_aggregate(
         not the serving party). In-flight generations finish on the
         version they pinned; the aggregate FedObject is still returned
         for the next round.
+    secure: lower the aggregation through the privacy plane
+        (docs/privacy.md): each contribution is clipped, fixed-point
+        encoded, and pairwise-masked AT its party; only masked envelopes
+        ride the wire; the root cancels the masks in the modular ring
+        and recovers exactly the aggregate. Requires
+        ``config["privacy"]["secure_aggregation"] = True`` at
+        ``fed.init``. Supports op sum/mean/wmean; the plan is forced
+        flat (an envelope is only unmaskable where ALL contributions
+        meet, so intermediate hops cannot partially reduce). Works with
+        ``mode="async"`` (masked offers buffer per round at the root).
     """
     assert objs, "need at least one party's object"
+    if secure:
+        from rayfed_tpu.privacy.manager import require_privacy_manager
+
+        mgr = require_privacy_manager("fed_aggregate(secure=True)")
+        if not mgr.config.secure_aggregation:
+            raise ValueError(
+                "fed_aggregate(secure=True) needs "
+                'config["privacy"]["secure_aggregation"] = True at '
+                "fed.init (the privacy block is present but secure "
+                "aggregation is off)"
+            )
+        if op not in ("sum", "mean", "wmean"):
+            raise ValueError(
+                f"secure aggregation supports op sum/mean/wmean, got {op!r}"
+            )
+        if mode == "sync":
+            if topology not in (None, "auto", "flat"):
+                raise ValueError(
+                    f"secure aggregation is single-hop: a masked envelope "
+                    f"is only unmaskable once every contribution meets, so "
+                    f"topology={topology!r} cannot partially reduce at "
+                    f"intermediate hops — use 'flat' (or drop topology=)"
+                )
+            topology = "flat"
+            if plan is not None and not topo.plan_is_flat(plan):
+                raise ValueError(
+                    "secure aggregation needs a flat plan (single hop); "
+                    "re-plan with topology='flat'"
+                )
     if mode in ("sync", "async"):
         _m_aggregates.labels(mode=mode).inc()
     if mode == "async":
@@ -202,6 +322,7 @@ def fed_aggregate(
             buffer_k=buffer_k,
             staleness_fn=staleness_fn,
             publish_to=publish_to,
+            secure=secure,
         )
     if mode != "sync":
         raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
@@ -248,6 +369,13 @@ def fed_aggregate(
                 f"op='wmean' weights missing entries for parties "
                 f"{sorted(missing_w)}"
             )
+
+    if secure:
+        # Privacy-plane lowering: masks at the parties, one cancel-and-
+        # decode reduce at the root (which itself lowers the ring sum to
+        # the composed-mesh collective when one is registered — the
+        # secure twin of the fast path below).
+        return _secure_sync_aggregate(plan, objs, op, weights, publish_to)
 
     # Same-mesh fast path: a flat plan over parties that compose into one
     # registered mesh lowers to a single collective task at the root.

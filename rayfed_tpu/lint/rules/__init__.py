@@ -22,6 +22,7 @@ from rayfed_tpu.lint.rules.dangling import DanglingFedObjectRule
 from rayfed_tpu.lint.rules.divergence import SeqDivergenceRule
 from rayfed_tpu.lint.rules.donation import DonationAliasingRule
 from rayfed_tpu.lint.rules.perimeter import PerimeterRule
+from rayfed_tpu.lint.rules.privacy import InsecureAggregateRule
 from rayfed_tpu.lint.rules.reserved_seq import ReservedSeqIdRule
 
 ALL_RULES: Tuple[Rule, ...] = (
@@ -30,6 +31,7 @@ ALL_RULES: Tuple[Rule, ...] = (
     DonationAliasingRule(),
     DanglingFedObjectRule(),
     ReservedSeqIdRule(),
+    InsecureAggregateRule(),
 )
 
 

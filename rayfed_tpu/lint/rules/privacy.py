@@ -1,0 +1,147 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED006 ``insecure-aggregate``: the job enables the privacy plane but
+an aggregation bypasses it.
+
+A driver whose ``fed.init`` config literal turns on
+``privacy.secure_aggregation`` has declared that per-party updates must
+not cross the wire in the clear. Two shapes break that declaration:
+
+1. ``fed_aggregate(...)`` without ``secure=True`` — the reduction runs
+   through the plaintext fold, shipping raw updates hop to hop;
+2. a raw ``.party(...).remote(...)`` push whose argument is a
+   gradient/weight-named tensor — model updates leaving the party
+   outside any aggregation, plaintext by construction.
+
+The rule only fires when the privacy block is statically visible as a
+dict literal in the same file (conservative: config built elsewhere
+stays silent). Intentional plaintext calls — debugging, public metrics —
+carry ``# fedlint: disable=insecure-aggregate`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Tuple
+
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import FED_AGGREGATE, DriverModel
+
+#: Argument names that look like model updates (the tensors secure
+#: aggregation exists to protect).
+_UPDATE_NAME_RE = re.compile(
+    r"(^|_)(grads?|gradients?|weights?)($|_|\d)", re.IGNORECASE
+)
+
+
+def _privacy_block(init_call: ast.Call) -> Optional[ast.Dict]:
+    """The ``privacy`` sub-dict literal of an init call's ``config=``
+    dict literal, or None."""
+    for kw in init_call.keywords:
+        if kw.arg != "config" or not isinstance(kw.value, ast.Dict):
+            continue
+        for key, value in zip(kw.value.keys, kw.value.values):
+            if (
+                isinstance(key, ast.Constant)
+                and key.value == "privacy"
+                and isinstance(value, ast.Dict)
+            ):
+                return value
+    return None
+
+
+def _dict_truthy(d: ast.Dict, name: str) -> bool:
+    for key, value in zip(d.keys, d.values):
+        if (
+            isinstance(key, ast.Constant)
+            and key.value == name
+            and isinstance(value, ast.Constant)
+        ):
+            return bool(value.value)
+    return False
+
+
+class InsecureAggregateRule(Rule):
+    rule_id = "FED006"
+    name = "insecure-aggregate"
+    summary = "privacy plane enabled but an aggregation bypasses it"
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        if not self._secure_aggregation_enabled(model):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if model.canonical_call(node) == FED_AGGREGATE:
+                if not self._passes_secure(node):
+                    yield (
+                        node,
+                        "this job enables privacy.secure_aggregation but "
+                        "fed_aggregate runs the PLAINTEXT fold — raw "
+                        "per-party updates ride the wire; pass "
+                        "secure=True (or suppress an intentional "
+                        "plaintext call with "
+                        "# fedlint: disable=insecure-aggregate)",
+                    )
+                continue
+            update = self._raw_update_push(node, model)
+            if update is not None:
+                yield (
+                    node,
+                    f"this job enables privacy.secure_aggregation but "
+                    f"{update!r} is pushed raw via .remote() outside any "
+                    f"aggregation — gradient/weight tensors leaving the "
+                    f"party in the clear bypass the masks; route them "
+                    f"through fed_aggregate(secure=True) (or suppress "
+                    f"with # fedlint: disable=insecure-aggregate)",
+                )
+
+    def _secure_aggregation_enabled(self, model: DriverModel) -> bool:
+        for init_call in model.init_calls:
+            block = _privacy_block(init_call)
+            if block is not None and _dict_truthy(
+                block, "secure_aggregation"
+            ):
+                return True
+        return False
+
+    def _passes_secure(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "secure":
+                if isinstance(kw.value, ast.Constant):
+                    return bool(kw.value.value)
+                return True  # computed value: assume the driver decides
+            if kw.arg is None:
+                return True  # **kwargs: cannot see inside
+        return False
+
+    def _raw_update_push(
+        self, call: ast.Call, model: DriverModel
+    ) -> Optional[str]:
+        """The first gradient/weight-named argument of a ``.remote()``
+        push, or None when this call is not one."""
+        inv = model.remote_invocation(call)
+        if inv is None:
+            return None
+        candidates = list(call.args) + [
+            kw.value for kw in call.keywords if kw.arg is not None
+        ]
+        for arg in candidates:
+            if isinstance(arg, ast.Name) and _UPDATE_NAME_RE.search(arg.id):
+                return arg.id
+        return None

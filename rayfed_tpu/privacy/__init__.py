@@ -1,0 +1,95 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""The privacy plane: secure aggregation, the DP ledger, and quantized
+pushes (docs/privacy.md).
+
+Three layers, all off by default and enabled through a validated
+``config["privacy"]`` block at ``fed.init``:
+
+- **Secure aggregation** (``secagg.py`` + ``manager.py``): pairwise
+  additive masks over the ``Z_{2^32}`` fixed-point ring, seeds exchanged
+  over authenticated ``prv:`` control frames, dropout recovery driven by
+  the liveness view and membership eviction. ``fed_aggregate(...,
+  secure=True)`` lowers through it on the stepwise, same-mesh psum, and
+  async buffered paths.
+- **Differential privacy** (``dp.py``): per-party clipping before a
+  contribution leaves the party, aggregator-side Gaussian noise, and the
+  per-party epsilon ledger ``fed.privacy_ledger()`` exposes.
+- **Quantized pushes** (``quantize.py``): the int8 wire tier
+  (``payload_wire_dtype="int8"``) and the driver-tier error-feedback
+  quantizer.
+"""
+
+from rayfed_tpu.privacy.config import (
+    PrivacyConfig,
+    QUANTIZE_TIERS,
+    validate_wire_dtype_gate,
+)
+from rayfed_tpu.privacy.dp import (
+    PrivacyLedger,
+    clip_tree,
+    gaussian_epsilon,
+    gaussian_noise_tree,
+    tree_l2_norm,
+)
+from rayfed_tpu.privacy.manager import (
+    PrivacyManager,
+    get_privacy_manager,
+    install_privacy,
+    record_quantized_bytes_saved,
+    require_privacy_manager,
+    uninstall_privacy,
+)
+from rayfed_tpu.privacy.protocol import (
+    PRIVACY_SEQ_PREFIX,
+    RECOVER_SEQ,
+    SEED_SEQ,
+    is_privacy_seq_id,
+)
+from rayfed_tpu.privacy.quantize import (
+    ErrorFeedbackQuantizer,
+    dequantize_leaf,
+    dequantize_tree,
+    quantize_leaf,
+    quantize_tree,
+)
+from rayfed_tpu.privacy.secagg import SecAggError
+
+__all__ = [
+    "PrivacyConfig",
+    "QUANTIZE_TIERS",
+    "validate_wire_dtype_gate",
+    "PrivacyLedger",
+    "clip_tree",
+    "gaussian_epsilon",
+    "gaussian_noise_tree",
+    "tree_l2_norm",
+    "PrivacyManager",
+    "get_privacy_manager",
+    "install_privacy",
+    "record_quantized_bytes_saved",
+    "require_privacy_manager",
+    "uninstall_privacy",
+    "PRIVACY_SEQ_PREFIX",
+    "RECOVER_SEQ",
+    "SEED_SEQ",
+    "is_privacy_seq_id",
+    "SecAggError",
+    "ErrorFeedbackQuantizer",
+    "dequantize_leaf",
+    "dequantize_tree",
+    "quantize_leaf",
+    "quantize_tree",
+]

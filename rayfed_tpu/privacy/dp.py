@@ -1,0 +1,144 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Differential privacy for federated aggregation: per-party clipping,
+aggregator-side Gaussian noise, and the per-party epsilon ledger.
+
+The mechanism is DP-FedAvg (McMahan et al. 2018): each party clips its
+update to L2 norm ``privacy.clip_norm`` BEFORE it leaves the party (so
+the sensitivity bound holds even against the aggregator), and the root
+adds Gaussian noise with per-coordinate stddev
+``noise_multiplier * clip_norm / n`` to the aggregated MEAN — the
+standard calibration for sensitivity ``clip_norm / n`` of one party's
+contribution to the mean of ``n``.
+
+The ledger accounts a per-round epsilon for the Gaussian mechanism at
+the configured delta (``eps = sqrt(2 ln(1.25/delta)) / z``, the classic
+analytic bound) and composes rounds with BASIC composition — a
+deliberately conservative over-estimate; callers wanting moments
+accounting can post-process the per-round record the snapshot exposes.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def tree_l2_norm(tree: Any) -> float:
+    import jax
+
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf, dtype=np.float64)
+        total += float(np.sum(arr * arr))
+    return math.sqrt(total)
+
+
+def clip_tree(tree: Any, clip_norm: float) -> Any:
+    """Scale the whole tree so its global L2 norm is at most
+    ``clip_norm`` (identity when already within the ball — bit-
+    preserving, so clipping never perturbs an in-bound update)."""
+    import jax
+
+    norm = tree_l2_norm(tree)
+    if norm <= clip_norm or norm == 0.0:
+        return tree
+    factor = clip_norm / norm
+    return jax.tree_util.tree_map(
+        lambda x: (np.asarray(x, dtype=np.float64) * factor).astype(
+            np.asarray(x).dtype
+        ),
+        tree,
+    )
+
+
+def gaussian_noise_tree(
+    tree: Any, stddev: float, seed: int, round_index: int
+) -> Any:
+    """Add iid N(0, stddev^2) per coordinate, drawn from a jax PRNG
+    stream keyed on (seed, round) so every replica of the root task
+    adds the identical noise (the determinism contract survives DP)."""
+    import jax
+    import jax.numpy as jnp
+
+    if stddev <= 0.0:
+        return tree
+    key = jax.random.PRNGKey(int(seed) % (1 << 63))
+    key = jax.random.fold_in(key, int(round_index) & 0x7FFFFFFF)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for idx, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        k = jax.random.fold_in(key, idx)
+        noise = jax.random.normal(k, shape=arr.shape, dtype=jnp.float32)
+        out.append(
+            (arr.astype(np.float64) + np.asarray(noise, np.float64) * stddev)
+            .astype(arr.dtype)
+        )
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def gaussian_epsilon(noise_multiplier: float, delta: float) -> float:
+    """Per-round epsilon of the Gaussian mechanism at noise multiplier
+    ``z`` (stddev / sensitivity) and ``delta``: the analytic
+    ``sqrt(2 ln(1.25/delta)) / z`` bound (valid for eps <= 1 regimes;
+    reported as-is otherwise — the ledger is an accounting surface, not
+    a proof)."""
+    if noise_multiplier <= 0.0:
+        return math.inf
+    return math.sqrt(2.0 * math.log(1.25 / delta)) / noise_multiplier
+
+
+class PrivacyLedger:
+    """Per-party, per-session epsilon accounting.
+
+    ``record_round`` charges every contributing party one Gaussian-
+    mechanism round; ``snapshot`` is msgpack-clean (it rides telemetry
+    and ``fed.privacy_ledger()``)."""
+
+    def __init__(self, delta: float) -> None:
+        self._delta = float(delta)
+        self._lock = threading.Lock()
+        self._rounds: Dict[str, int] = {}
+        self._epsilon: Dict[str, float] = {}
+
+    def record_round(
+        self, parties, noise_multiplier: Optional[float]
+    ) -> None:
+        if not noise_multiplier:
+            return
+        eps = gaussian_epsilon(float(noise_multiplier), self._delta)
+        with self._lock:
+            for p in parties:
+                self._rounds[p] = self._rounds.get(p, 0) + 1
+                self._epsilon[p] = self._epsilon.get(p, 0.0) + eps
+
+    def epsilon(self, party: str) -> float:
+        with self._lock:
+            return self._epsilon.get(party, 0.0)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                p: {
+                    "epsilon": self._epsilon[p],
+                    "delta": self._delta,
+                    "rounds": self._rounds[p],
+                }
+                for p in sorted(self._epsilon)
+            }

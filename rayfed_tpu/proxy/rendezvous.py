@@ -57,7 +57,10 @@ DecodeFn = Callable[[Dict, memoryview], object]
 #: earn the same explicit refusal instead of wedging in ``_arrived``.
 CONTROL_SEQ_PREFIX = "mbr:req:"    # membership control (membership/protocol.py)
 TELEMETRY_SEQ_PREFIX = "tel:"      # telemetry agent pushes (telemetry/agent.py)
-CONTROL_NAMESPACES: Tuple[str, ...] = (CONTROL_SEQ_PREFIX, TELEMETRY_SEQ_PREFIX)
+PRIVACY_SEQ_PREFIX = "prv:"        # privacy plane (privacy/protocol.py)
+CONTROL_NAMESPACES: Tuple[str, ...] = (
+    CONTROL_SEQ_PREFIX, TELEMETRY_SEQ_PREFIX, PRIVACY_SEQ_PREFIX,
+)
 
 # Per-job control/membership hooks. Control handlers are keyed by
 # (job_name, seq-id prefix) — membership registers CONTROL_SEQ_PREFIX
@@ -492,6 +495,8 @@ class RendezvousStore:
                         if key[0].startswith(CONTROL_SEQ_PREFIX)
                         else "telemetry collector"
                         if key[0].startswith(TELEMETRY_SEQ_PREFIX)
+                        else "privacy peer"
+                        if key[0].startswith(PRIVACY_SEQ_PREFIX)
                         else "control handler"
                     )
                     return (

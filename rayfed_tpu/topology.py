@@ -287,6 +287,18 @@ def replan(old: TopologyPlan, dead: Iterable[str],
     )
 
 
+def plan_secure(
+    parties: Iterable[str], dead: Iterable[str] = ()
+) -> TopologyPlan:
+    """The one plan shape secure aggregation can lower to: a flat
+    single-hop star (docs/privacy.md). A pairwise-masked envelope is a
+    one-time pad — only the COMPLETE group's modular sum decodes — so an
+    intermediate tree/ring/hier hop could neither read nor partially
+    reduce what passes through it; ``fed_aggregate(secure=True)`` forces
+    this shape regardless of the job's topology default."""
+    return plan(list(parties), "flat", dead=set(dead))
+
+
 def plan_buffer(slots: Iterable[str]) -> TopologyPlan:
     """A flat plan over buffered-arrival SLOT labels (async rounds,
     docs/async_rounds.md): the async aggregator folds its buffer in

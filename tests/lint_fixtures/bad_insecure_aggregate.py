@@ -1,0 +1,49 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED006 insecure aggregate (expected findings: 2).
+
+The job turns on privacy.secure_aggregation, then (1) aggregates through
+the plaintext fold and (2) pushes gradient-named tensors raw via
+.remote() — both ship per-party updates in the clear."""
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import fed_aggregate
+
+fed.init(
+    addresses={"alice": "127.0.0.1:9000", "bob": "127.0.0.1:9001"},
+    party="alice",
+    config={"privacy": {"secure_aggregation": True}},
+)
+
+
+@fed.remote
+def local_grads():
+    return {"w": [1.0, 2.0]}
+
+
+@fed.remote
+def consume(tree):
+    return tree
+
+
+def insecure_round():
+    objs = {p: local_grads.party(p).remote() for p in ("alice", "bob")}
+    # BAD: the privacy plane is on but this is the plaintext fold.
+    return fed_aggregate(objs, op="mean")
+
+
+def leak_raw_gradients(grads):
+    # BAD: gradient-named tensor pushed raw, outside any aggregation.
+    return consume.party("bob").remote(grads)

@@ -1,0 +1,54 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""fedlint fixture: FED006 negative case (expected findings: 0).
+
+Same privacy-enabled job, but every aggregation goes through
+secure=True, the raw push carries no update-named tensor, and the one
+intentional plaintext debug aggregate is suppressed in place."""
+
+import rayfed_tpu as fed
+from rayfed_tpu.federated import fed_aggregate
+
+fed.init(
+    addresses={"alice": "127.0.0.1:9000", "bob": "127.0.0.1:9001"},
+    party="alice",
+    config={"privacy": {"secure_aggregation": True}},
+)
+
+
+@fed.remote
+def local_grads():
+    return {"w": [1.0, 2.0]}
+
+
+@fed.remote
+def consume(tree):
+    return tree
+
+
+def secure_round():
+    objs = {p: local_grads.party(p).remote() for p in ("alice", "bob")}
+    # GOOD: lowers through the privacy plane's masked reduction.
+    return fed_aggregate(objs, op="mean", secure=True)
+
+
+def share_public_metrics(metrics):
+    # GOOD: not an update-named tensor; nothing the masks protect.
+    return consume.party("bob").remote(metrics)
+
+
+def debug_round(objs):
+    # GOOD: intentional plaintext comparison, suppressed in place.
+    return fed_aggregate(objs)  # fedlint: disable=insecure-aggregate

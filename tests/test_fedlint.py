@@ -47,6 +47,7 @@ BAD_FIXTURES = {
     "bad_donation_aliasing.py": ("FED003", 1),
     "bad_dangling_fedobject.py": ("FED004", 2),
     "bad_reserved_seq_id.py": ("FED005", 2),
+    "bad_insecure_aggregate.py": ("FED006", 2),
 }
 
 GOOD_FIXTURES = [
@@ -55,6 +56,7 @@ GOOD_FIXTURES = [
     "good_donation_aliasing.py",
     "good_dangling_fedobject.py",
     "good_reserved_seq_id.py",
+    "good_insecure_aggregate.py",
     "suppressed.py",
 ]
 
@@ -160,7 +162,7 @@ def test_api_anchors_name_real_rules():
     from rayfed_tpu.api import FEDLINT_ANCHORS
 
     known = {r.rule_id for r in ALL_RULES}
-    assert set(FEDLINT_ANCHORS) == {"get", "remote"}
+    assert set(FEDLINT_ANCHORS) == {"get", "remote", "aggregate"}
     for entry, rule_ids in FEDLINT_ANCHORS.items():
         assert rule_ids, entry
         assert set(rule_ids) <= known, (entry, rule_ids)

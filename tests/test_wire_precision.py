@@ -156,3 +156,75 @@ def test_bf16_buffer_is_zero_copy_view():
     buf = ser._array_buffer(arr)
     assert isinstance(buf, memoryview)
     assert buf.nbytes == arr.nbytes
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized tier (privacy plane): per-leaf symmetric scale rides the
+# meta (``qs``), payload shrinks 4x, error bounded by half a grid step.
+
+
+def test_int8_error_bound_and_dtype_restoration():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(1024,)).astype(np.float32)
+    out = _roundtrip({"g": x}, "int8")["g"]
+    assert out.dtype == np.float32
+    # Symmetric 127-level grid: absolute error <= scale / 2.
+    scale = np.abs(x).max() / 127.0
+    np.testing.assert_allclose(out, x, rtol=0, atol=scale / 2 + 1e-12)
+    assert not np.array_equal(out, x)  # genuinely lossy on random data
+
+
+def test_int8_grid_points_roundtrip_exactly():
+    # Values already on the quantization grid survive bitwise.
+    scale = 127.0 / 127.0
+    x = (np.arange(-127, 128, dtype=np.float32) * scale).astype(np.float32)
+    out = _roundtrip({"g": x}, "int8")["g"]
+    np.testing.assert_array_equal(out, x)
+
+
+def test_int8_wire_bytes_actually_quarter():
+    x = np.zeros(1024, np.float32)
+    _, _, raw = ser.encode_payload({"g": x})
+    _, _, quant = ser.encode_payload(
+        {"g": x}, wire_dtype=ser.wire_dtype_name("int8")
+    )
+    assert sum(memoryview(b).nbytes for b in quant) * 4 == sum(
+        memoryview(b).nbytes for b in raw
+    )
+
+
+def test_int8_meta_carries_scale_and_origin_dtype():
+    import msgpack
+
+    x = np.linspace(-2.0, 2.0, 32, dtype=np.float64)
+    meta_bytes, _ = ser.try_encode_tree(
+        {"g": x}, wire_dtype=ser.wire_dtype_name("int8")
+    )
+    meta = msgpack.unpackb(meta_bytes)
+    descs = [d for d in meta["leaves"] if isinstance(d, dict) and "qs" in d]
+    assert len(descs) == 1
+    (d,) = descs
+    assert d["dtype"] == "int8"
+    assert d["odt"] == "float64"
+    assert d["qs"] == pytest.approx(2.0 / 127.0)
+
+
+def test_int8_non_float_and_narrow_leaves_untouched():
+    tree = {
+        "i": np.arange(16, dtype=np.int32),
+        "b": np.array([True, False]),
+        "h": np.array([1.5, 2.5], np.float16),  # already narrow
+        "s": "label",
+    }
+    out = _roundtrip(tree, "int8")
+    np.testing.assert_array_equal(out["i"], tree["i"])
+    np.testing.assert_array_equal(out["b"], tree["b"])
+    assert out["h"].dtype == np.float16
+    np.testing.assert_array_equal(out["h"], tree["h"])
+    assert out["s"] == "label"
+
+
+def test_int8_all_zero_leaf_stable():
+    x = np.zeros(64, np.float32)
+    out = _roundtrip({"g": x}, "int8")["g"]
+    np.testing.assert_array_equal(out, x)

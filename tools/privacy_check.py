@@ -1,0 +1,144 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Privacy-plane gate (docs/privacy.md).
+
+Runs bench.py's 3-party secagg stage (spawned processes, real TCP
+transport, real ``prv:seed`` exchange): paired plaintext / secure
+FedAvg windows on integer-valued updates, plus an int8 error-feedback
+quantized-push window. FAILS LOUDLY — exit code 1 — when the masking
+path starts costing real money or, worse, stops being EXACT. Wire this
+into CI so a change that quietly breaks mask cancellation (a re-keyed
+stream, a float sneaking into the ring fold, a scale op drifting from
+the plaintext twin) turns the build red.
+
+Three gates:
+
+  bitwise  — ``secagg_bitwise_equal`` must be 1: every secure round's
+             aggregate byte-identical to the locally recomputed
+             plaintext fold. This is the mask-cancellation witness and
+             it is NON-NEGOTIABLE — a secure path that is "close" is a
+             secure path that is wrong (the ring arithmetic is exact by
+             construction; any drift means the contract broke).
+  overhead — ``secure_agg_overhead_pct`` (median over paired windows)
+             must stay under budget. Secure rounds pay 2 extra task
+             hops plus the PRNG mask streams per round, so the ratio on
+             tiny benchmark payloads is structurally high (~150% on a
+             quiet host); the default 400% ceiling catches the
+             pathological regressions — per-element rekeying, an extra
+             tree copy in the mask loop — not host noise.
+  quant    — ``quantized_push_gbps`` (original float bytes per second
+             through the int8 error-feedback wire path) must hold an
+             anti-gaming floor: the 4x byte saving must not be bought
+             with a quantizer too slow to ever win.
+
+A total wall-clock budget bounds the whole check so a wedged seed
+handshake (a party waiting out ``handshake_timeout_s``) fails fast
+instead of eating the CI job timeout.
+
+Budgets:
+
+  FEDTPU_SECAGG_BUDGET_PCT       default 400 — secure-vs-plain ceiling.
+  FEDTPU_QUANT_FLOOR_GBPS        default 0.02 — quantized-push floor.
+  FEDTPU_SECAGG_ROUNDS           default 12 rounds per window.
+  FEDTPU_SECAGG_WALL_BUDGET_S    default 300 — cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    budget_pct = float(os.environ.get("FEDTPU_SECAGG_BUDGET_PCT", "400"))
+    quant_floor = float(os.environ.get("FEDTPU_QUANT_FLOOR_GBPS", "0.02"))
+    rounds = int(os.environ.get("FEDTPU_SECAGG_ROUNDS", "12"))
+    wall_budget_s = float(
+        os.environ.get("FEDTPU_SECAGG_WALL_BUDGET_S", "300")
+    )
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            bench._secagg_party, "tcp", (rounds,),
+            timeout_s=wall_budget_s, parties=bench._SECAGG3,
+        )
+    elapsed = time.monotonic() - t0
+    if elapsed > wall_budget_s:
+        print(
+            f"PRIVACY GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed "
+            f"exceeds the {wall_budget_s:.0f}s budget — a seed handshake "
+            f"or secure fold wedged, not just a slow host.",
+            file=sys.stderr,
+        )
+        return 1
+
+    overhead = res["secure_agg_overhead_pct"]
+    bitwise = bool(res["secagg_bitwise_equal"])
+    quant_gbps = res["quantized_push_gbps"]
+    print(
+        f"secure_agg_overhead={overhead:.1f}% bitwise={bitwise} "
+        f"quantized_push={quant_gbps:.3f}GB/s in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    failed = False
+    if not bitwise:
+        failed = True
+        print(
+            "PRIVACY REGRESSION: a secure round's aggregate was NOT "
+            "byte-identical to the plaintext fold on integer-valued "
+            "updates — mask cancellation broke. The ring arithmetic is "
+            "exact by construction, so any drift means a stream was "
+            "re-keyed, a float leaked into the modular fold, or the "
+            "root's scale op diverged from the plaintext twin "
+            "(docs/privacy.md, 'Exactness contract').",
+            file=sys.stderr,
+        )
+    if overhead > budget_pct:
+        failed = True
+        print(
+            f"PRIVACY REGRESSION: secure_agg_overhead_pct {overhead:.1f} "
+            f"is over the {budget_pct:.0f}% budget — secure rounds should "
+            f"cost 2 extra task hops plus the pairwise PRNG streams, not "
+            f"per-element rekeying or an extra tree copy in the mask "
+            f"loop.",
+            file=sys.stderr,
+        )
+    if quant_gbps < quant_floor:
+        failed = True
+        print(
+            f"PRIVACY REGRESSION: quantized_push_gbps {quant_gbps:.3f} is "
+            f"under the {quant_floor:.3f} GB/s floor — the int8 tier's "
+            f"4x byte saving must not be bought with a quantizer too "
+            f"slow to ever win (check for a per-leaf Python loop or a "
+            f"float64 copy on the hot path).",
+            file=sys.stderr,
+        )
+    if failed:
+        return 1
+    print(f"privacy gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
