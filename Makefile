@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma shm serve async churn obs privacy clean
+.PHONY: native test lint chaos latency scale dma shm serve async churn obs privacy ha clean
 
 native:
 	python setup.py build_ext --inplace
@@ -110,6 +110,17 @@ obs:
 privacy:
 	JAX_PLATFORMS=cpu python tools/privacy_check.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_privacy.py -q
+
+# HA gate (docs/ha.md): control-plane failover under fire — the
+# configured coordinator crash-killed mid-sync-broadcast, the
+# deterministic successor taking over the sync point under term 1.
+# ha_rounds_lost must stay 0, the successor must actually hold the
+# role, and coordinator_failover_ms must stay under
+# FEDTPU_HA_BUDGET_MS, plus the failover/handoff/checkpoint chaos
+# tests. Mirrors the `ha` job in .github/workflows/tests.yml.
+ha:
+	JAX_PLATFORMS=cpu python tools/ha_check.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ha.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -1773,6 +1773,134 @@ def _churn_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_HA3 = ("alice", "bob", "carol")
+
+
+def _ha_party(party, addresses, transport, result_path, rounds):
+    """Control-plane HA stage (docs/ha.md): a 3-party FedAvg where the
+    CONFIGURED COORDINATOR (alice) is crash-killed mid-sync-broadcast by
+    an injected fault; the deterministic successor (bob) deposes it on
+    the liveness DEAD verdict, adopts term 1, and takes over the sync
+    point — re-broadcasting the retained views so the member whose recv
+    the crash orphaned (carol) converges on the same roster. Headline
+    metrics tools/ha_check.py gates:
+
+      coordinator_failover_ms — the longest membership_sync wait the
+                         successor paid across the run: the round stall
+                         the takeover cost (DEAD verdict + deterministic
+                         election + takeover re-broadcast).
+      ha_rounds_lost   — rounds that aggregated zero contributors on the
+                         successor (must be 0: failover must degrade
+                         rounds, never lose them).
+      ha_failed_over   — the successor actually holds the coordinator
+                         role at a term >= 1 when the run ends.
+    """
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.membership.manager import get_membership_manager
+    from rayfed_tpu.ops.aggregate import elastic_weighted_mean
+    from rayfed_tpu.resilience.liveness import DEAD
+
+    crash_round = 2  # alice makes 4 data sends per healthy round (the
+    #                  sync broadcast to each member, then its update
+    #                  push to each consumer); after=9 kills it MID the
+    #                  round-2 sync broadcast — one member holds sync 3,
+    #                  the other waits for the takeover re-broadcast.
+    bases = {"alice": 1.0, "bob": 2.0, "carol": 3.0}
+    comm = {
+        "retry_policy": {
+            "max_attempts": 2,
+            "initial_backoff_ms": 50,
+            "max_backoff_ms": 100,
+        },
+        "timeout_in_ms": 2000,
+        "recv_timeout_in_ms": 2000,
+        "send_deadline_in_ms": 4000,
+    }
+    config = {
+        "barrier_on_initializing": True,
+        "cross_silo_comm": dict(comm),
+        "transport": transport,
+        "resilience": {
+            "liveness": {
+                "interval_ms": 100, "suspect_after": 2, "dead_after": 4,
+                "timeout_ms": 300,
+            },
+        },
+        "membership": {
+            "coordinator": "alice",
+            "evict_dead": True,
+            "sync_timeout_s": 30.0,
+            "failover": {"takeover_timeout_s": 0.5, "resync_window": 8},
+        },
+    }
+    if party == "alice":
+        config["cross_silo_comm"]["exit_on_sending_failure"] = True
+        config["resilience"]["fault_schedule"] = {
+            "seed": 11,
+            "rules": [{"fault": "crash", "src": "alice",
+                       "after": 4 * crash_round + 1}],
+        }
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config=config,
+        job_name=f"bench-ha-{transport}",
+        logging_level="error",
+        sending_failure_handler=(
+            (lambda e: os._exit(0)) if party == "alice" else None
+        ),
+    )
+
+    @fed.remote
+    def contrib(base, r):
+        return {"g": np.full((1 << 12,), base * (r + 1), np.float32)}
+
+    per_round = []
+    max_sync_ms = 0.0
+    try:
+        for r in range(rounds):
+            t0 = time.monotonic()
+            view = fed.membership_sync(timeout=30.0)
+            max_sync_ms = max(max_sync_ms, (time.monotonic() - t0) * 1e3)
+            roster = sorted(view.roster)
+            objs = {p: contrib.party(p).remote(bases[p], r) for p in roster}
+            got = fed.get([objs[p] for p in roster], timeout=3.0,
+                          on_missing="default")
+            contribs = dict(zip(roster, got))
+            live = fed.liveness_view()
+            agg = elastic_weighted_mean(contribs, liveness=live)
+            assert np.isfinite(np.asarray(agg["g"]).sum())
+            per_round.append([
+                p for p in roster
+                if contribs[p] is not fed.MISSING and live.get(p) != DEAD
+            ])
+            time.sleep(0.2)
+    except BaseException:
+        if party == "alice" and len(per_round) >= crash_round - 1:
+            os._exit(0)  # expected death throes after the injected crash
+        raise
+    if party == "alice":
+        raise AssertionError("alice survived its own crash schedule")
+    if party == "bob":
+        mgr = get_membership_manager()
+        stats = fed.membership_stats()
+        failed_over = (
+            mgr.coordinator() == "bob"
+            and stats.get("term", 0) >= 1
+            and stats.get("takeovers", 0) >= 1
+        )
+        with open(result_path, "w") as f:
+            json.dump({
+                "coordinator_failover_ms": max_sync_ms,
+                "ha_rounds_lost": sum(1 for c in per_round if not c),
+                "ha_failed_over": int(failed_over),
+                "ha_rounds": rounds,
+            }, f)
+    fed.shutdown()
+
+
 _OBS3 = ("alice", "bob", "carol")
 
 
@@ -2344,6 +2472,21 @@ def main() -> None:
             "churn_epoch": "churn_epoch",
             "churn_entry_round": "churn_entry_round",
             "churn_rounds": "churn_rounds",
+        },
+    ))
+    # Control-plane HA (docs/ha.md): the configured coordinator is
+    # crash-killed mid-sync-broadcast; the deterministic successor
+    # deposes it at the liveness verdict and takes over the sync point
+    # under term 1. tools/ha_check.py gates the failover stall and
+    # rounds lost.
+    result.update(_bench_stage(
+        _ha_party, "coordinator_failover_ms", "FEDTPU_BENCH_HA_ROUNDS", 8,
+        [("tcp", "coordinator_failover_ms")], cpu_force=True, parties=_HA3,
+        timeout_s=300, digits=1,
+        extra_fields={
+            "ha_rounds_lost": "ha_rounds_lost",
+            "ha_failed_over": "ha_failed_over",
+            "ha_rounds": "ha_rounds",
         },
     ))
     # Telemetry plane (docs/observability.md): paired on/off windows

@@ -30,13 +30,17 @@ from rayfed_tpu.api import (  # noqa: F401
     join,
     kill,
     leave,
+    membership_stats,
     membership_sync,
     membership_view,
     privacy_ledger,
     remote,
     shutdown,
 )
-from rayfed_tpu.exceptions import FedRemoteError  # noqa: F401
+from rayfed_tpu.exceptions import (  # noqa: F401
+    FedRemoteError,
+    StaleCoordinatorError,
+)
 from rayfed_tpu.fed_object import FedObject  # noqa: F401
 from rayfed_tpu.proxy.barriers import recv, send  # noqa: F401
 from rayfed_tpu.resilience import (  # noqa: F401
@@ -52,7 +56,13 @@ from rayfed_tpu.serving import (  # noqa: F401
 )
 from rayfed_tpu.async_rounds import (  # noqa: F401  (after api import)
     AsyncRoundHandle,
+    async_handoff,
+    async_rebuild,
     async_round,
+)
+from rayfed_tpu.checkpoint import (  # noqa: F401
+    restore_job_state,
+    save_job_state,
 )
 from rayfed_tpu.telemetry import (  # noqa: F401
     export_fleet_trace,
@@ -78,14 +88,20 @@ __all__ = [
     "party_state",
     "join",
     "leave",
+    "membership_stats",
     "membership_sync",
     "membership_view",
     "privacy_ledger",
+    "StaleCoordinatorError",
     "serve",
     "submit_request",
     "ServeHandle",
     "async_round",
+    "async_handoff",
+    "async_rebuild",
     "AsyncRoundHandle",
+    "save_job_state",
+    "restore_job_state",
     "telemetry_snapshot",
     "export_fleet_trace",
     "__version__",

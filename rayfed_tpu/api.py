@@ -156,6 +156,16 @@ def init(
     validate_wire_dtype_gate(
         cross_silo_comm_dict.get("payload_wire_dtype"), privacy_dict
     )
+    # The checkpoint section is STRICT for the same reason: a typo'd
+    # retention key must reject init here, not the round-N save_job_state
+    # that was supposed to make the job restartable. Validated only at
+    # this point; the defaults are installed later, after the runtime
+    # exists, so a rejected init leaves no module state behind.
+    checkpoint_dict = config.get("checkpoint")
+    if checkpoint_dict is not None:
+        from rayfed_tpu.checkpoint import CheckpointConfig
+
+        CheckpointConfig.from_dict(checkpoint_dict)
     transport = transport or config.get("transport", "tcp")
     if (
         transport == "grpc"
@@ -404,6 +414,13 @@ def init(
         membership_manager.install()
         set_membership_manager(membership_manager)
 
+    # Job-checkpoint defaults (docs/ha.md): already validated before any
+    # state was built; installing them cannot fail at this point.
+    if checkpoint_dict is not None:
+        from rayfed_tpu import checkpoint as _checkpoint
+
+        _checkpoint.set_default_checkpoint_config(checkpoint_dict)
+
     # Privacy plane (docs/privacy.md): the manager owns the pairwise
     # seed store and the ``prv:`` control handler, the DP ledger, and
     # the error-feedback quantizer. AFTER membership (dropout recovery
@@ -499,8 +516,17 @@ def _shutdown(intended: bool = True):
     _inject.uninstall()
     # Membership hooks next (seq-id epoch stamp, rendezvous control
     # handler/roster): the drain below must run against the bare engine.
+    # An in-flight coordinator takeover finishes (bounded) against live
+    # proxies first — tearing the plane down mid-broadcast would strand
+    # survivors parked on a sync that will never come (docs/ha.md).
     _membership = sys.modules.get("rayfed_tpu.membership.manager")
     if _membership is not None:
+        _mbr_mgr = _membership.get_membership_manager()
+        if _mbr_mgr is not None:
+            try:
+                _mbr_mgr.drain_takeover(2.0)
+            except Exception:  # noqa: BLE001 - must not block teardown
+                logger.warning("membership drain failed", exc_info=True)
         _membership.clear_membership_manager()
     # Privacy plane: unregister the prv: control handler while the
     # rendezvous store is still up, and drop seeds/ledger — a new job
@@ -518,10 +544,18 @@ def _shutdown(intended: bool = True):
     _topology.reset_default()
     # Async aggregation sessions hold buffered contribution trees and
     # per-session version counters; a new job must not fold into them.
+    # Drain any mid-adopt aggregator handoff first (docs/ha.md).
     _async_rounds = sys.modules.get("rayfed_tpu.async_rounds")
     if _async_rounds is not None:
+        try:
+            _async_rounds.drain_handoffs(2.0)
+        except Exception:  # noqa: BLE001 - must not block teardown
+            logger.warning("async handoff drain failed", exc_info=True)
         _async_rounds.reset_sessions()
         _async_rounds.reset_default_async_config()
+    _checkpoint = sys.modules.get("rayfed_tpu.checkpoint")
+    if _checkpoint is not None:
+        _checkpoint.reset_default_checkpoint_config()
     # Serving engines hold jitted programs and a live thread; stop them
     # before the proxies so a submit task in flight fails loudly instead
     # of wedging teardown. Only touch the module if something imported it
@@ -662,6 +696,26 @@ def membership_view():
 
     manager = _mbr_manager.get_membership_manager()
     return None if manager is None else manager.view()
+
+
+def membership_stats() -> Dict[str, int]:
+    """This party's membership HA counters (the ``get_stats()`` mirror
+    of the ``fed_membership_*`` telemetry series, docs/ha.md): adopted
+    ``term``, ``failovers`` (depositions adopted), ``takeovers`` (times
+    THIS party won the election), ``stale_syncs_rejected``, plus —
+    on the coordinator — the fold counters (``epoch_bumps``,
+    ``joins_accepted``, ...). Empty on membership-free jobs."""
+    from rayfed_tpu.membership import manager as _mbr_manager
+
+    manager = _mbr_manager.get_membership_manager()
+    if manager is None:
+        return {}
+    out = manager.ha_stats()
+    out["term"] = manager.term()
+    coordinator = manager.get_coordinator_state()
+    if coordinator is not None:
+        out.update(coordinator.stats)
+    return out
 
 
 def privacy_ledger() -> Dict[str, Dict[str, float]]:

@@ -200,6 +200,7 @@ class BufferedAggregator:
             "dropped_stale": 0,
             "publishes": 0,
             "publish_errors": 0,
+            "handoffs": 0,
         }
         # Mirror every stats bump into the process-global telemetry
         # registry so the fleet view sees aggregator health without
@@ -237,6 +238,12 @@ class BufferedAggregator:
             "Newest round tag seen across offers.",
             labels=("session",),
         ).labels(session=session)
+        self._m_handoffs = _reg.counter(
+            "fed_async_handoffs_total",
+            "Aggregator states this party adopted from a handed-off or "
+            "checkpointed predecessor.",
+            labels=("session",),
+        ).labels(session=session)
 
     def _bump_stat_locked(self, key: str) -> None:
         self.stats[key] += 1
@@ -268,6 +275,79 @@ class BufferedAggregator:
             out["buffered"] = len(self._buffer)
             out["latest_round_tag"] = self._latest_tag
             return out
+
+    # -- state handoff (HA, docs/ha.md) -------------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """One consistent snapshot of everything a successor aggregator
+        needs to continue this session bitwise: the un-folded buffer in
+        arrival order, the arrival counter (slot labels must not
+        collide), the staleness ledger (latest round tag), the pending
+        secure groups, the published model, and the counters. The
+        returned dict is wire-clean — it rides a normal fed push to the
+        successor, or a checkpoint to disk."""
+        with self._lock:
+            return {
+                "session": self.session,
+                "cfg": self.cfg.as_dict(),
+                "buffer": [
+                    {
+                        "slot": c.slot, "party": c.party,
+                        "round_tag": c.round_tag, "staleness": c.staleness,
+                        "tree": c.tree, "weight": c.weight,
+                    }
+                    for c in self._buffer
+                ],
+                "arrivals": self._arrivals,
+                "latest_tag": self._latest_tag,
+                "secure_groups": {
+                    int(r): dict(g) for r, g in self._secure_groups.items()
+                },
+                "current": self._current,
+                "version": self.version,
+                "stats": dict(self.stats),
+            }
+
+    def adopt_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """Install a predecessor's :meth:`export_state` snapshot,
+        REPLACING this aggregator's state (the handoff target is a
+        fresh/empty successor; a checkpoint restore starts from an empty
+        registry). Counts one handoff. Returns ``snapshot_stats()``."""
+        with self._lock:
+            self._buffer = [
+                _Contribution(
+                    c["slot"], c["party"], int(c["round_tag"]),
+                    int(c["staleness"]), _snapshot_tree(c["tree"]),
+                    float(c["weight"]),
+                )
+                for c in state.get("buffer") or []
+            ]
+            self._arrivals = int(state.get("arrivals") or 0)
+            self._latest_tag = int(state.get("latest_tag", -1))
+            self._secure_groups = {
+                int(r): dict(g)
+                for r, g in (state.get("secure_groups") or {}).items()
+            }
+            self._current = state.get("current")
+            self.version = int(state.get("version") or 0)
+            prior = state.get("stats") or {}
+            for k in self.stats:
+                if k in prior:
+                    self.stats[k] = int(prior[k])
+            self.stats["handoffs"] += 1
+            self._m_handoffs.inc()
+            self._sync_gauges_locked()
+        tracing.record(
+            "failover", "", f"async:{self.session}", f"v{self.version}",
+            0, time.perf_counter(), event="handoff",
+            buffered=len(self._buffer),
+        )
+        logger.info(
+            "async session %r adopted handed-off state: v%d, %d buffered, "
+            "latest tag %d", self.session, self.version,
+            len(self._buffer), self._latest_tag,
+        )
+        return self.snapshot_stats()
 
     # -- the one mutating entry point ---------------------------------------
 
@@ -631,6 +711,7 @@ def reset_sessions() -> None:
         _sessions.clear()
     with _tags_lock:
         _driver_round_tags.clear()
+        _last_rounds.clear()
 
 
 def poke_secure_sessions() -> None:
@@ -680,6 +761,56 @@ def _async_current(name, cfg_dict, serve_name):
 
 
 @fed.remote
+def _async_export(name, cfg_dict, serve_name):
+    agg = _get_or_create_session(name, cfg_dict, serve_name)
+    return agg.export_state()
+
+
+@fed.remote
+def _async_adopt(name, cfg_dict, serve_name, state):
+    agg = _get_or_create_session(name, cfg_dict, serve_name)
+    _handoff_begin()
+    try:
+        return agg.adopt_state(state)
+    finally:
+        _handoff_end()
+
+
+# In-flight handoff adoption counter: ``fed.shutdown`` drains it so a
+# job shutting down during an aggregator handoff finishes installing the
+# adopted state before the session registry is cleared.
+_handoff_lock = threading.Lock()
+_handoff_cond = threading.Condition(_handoff_lock)
+_handoffs_inflight = 0
+
+
+def _handoff_begin() -> None:
+    global _handoffs_inflight
+    with _handoff_lock:
+        _handoffs_inflight += 1
+
+
+def _handoff_end() -> None:
+    global _handoffs_inflight
+    with _handoff_lock:
+        _handoffs_inflight -= 1
+        _handoff_cond.notify_all()
+
+
+def drain_handoffs(timeout: float = 2.0) -> bool:
+    """Block until no aggregator handoff is mid-adopt (or the timeout
+    lapses). Returns True when quiescent."""
+    deadline = time.monotonic() + max(0.0, timeout)
+    with _handoff_lock:
+        while _handoffs_inflight > 0:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            _handoff_cond.wait(remaining)
+        return True
+
+
+@fed.remote
 def _async_stats(name, cfg_dict, serve_name):
     agg = _get_or_create_session(name, cfg_dict, serve_name)
     return agg.snapshot_stats()
@@ -699,6 +830,12 @@ _default_cfg: Optional[AsyncAggregationConfig] = None
 # same program, so the counters advance identically on all parties.
 _tags_lock = threading.Lock()
 _driver_round_tags: Dict[str, int] = {}
+
+# Driver-side memory of the last async_round call per session — the
+# survivor re-offer source for :func:`async_rebuild` when the root died
+# without handing its buffer off. Identical on every driver (same calls,
+# same arguments), so a rebuild lays out the same DAG everywhere.
+_last_rounds: Dict[str, Dict[str, Any]] = {}
 
 
 def set_default_async_config(aggregation_dict: Dict[str, Any]) -> None:
@@ -864,7 +1001,80 @@ def async_round(
         handle.model = _async_current.party(root).remote(
             session, cfg_dict, serve_name
         )
+    with _tags_lock:
+        _last_rounds[session] = {
+            "objs": dict(objs),
+            "round_tag": int(round_tag),
+            "weights": None if weights is None else dict(weights),
+            "secure": bool(secure),
+        }
     return handle
+
+
+def async_handoff(
+    old_root: str, new_root: str, session: str = "default"
+) -> FedObject:
+    """Hand the session's aggregator state from ``old_root`` to
+    ``new_root``: the old root exports one consistent snapshot (buffer,
+    staleness ledger, secure groups, published model), the snapshot
+    rides a normal fed push, and the successor adopts it wholesale.
+    Every driver must make the identical call (multi-controller
+    contract). Returns a FedObject of the successor's post-adopt stats
+    at ``new_root`` — ``fed.get`` it for a bounded wait. Use when the
+    old root is still reachable (planned migration, drain); when it is
+    DEAD, use :func:`async_rebuild` instead."""
+    cfg_dict = get_default_async_config().as_dict()
+    state = _async_export.party(old_root).remote(session, cfg_dict, None)
+    return _async_adopt.party(new_root).remote(
+        session, cfg_dict, None, state
+    )
+
+
+def async_rebuild(
+    new_root: str,
+    session: str = "default",
+    parties: Optional[Any] = None,
+) -> AsyncRoundHandle:
+    """Rebuild the session's buffer at ``new_root`` from survivor
+    re-offers — the ``prv:recover`` pattern applied to the aggregator:
+    when the root died WITH its buffer, each surviving driver re-offers
+    its own last contribution (remembered from the most recent
+    :func:`async_round`) at the same round tag, and the successor's
+    fresh aggregator refolds them in re-arrival order. In-flight
+    contributions from dead parties are lost — the round DEGRADES to
+    the survivor set rather than disappearing with the root.
+
+    ``parties`` restricts the re-offer to the surviving roster (default:
+    every party of the remembered round). Every driver must make the
+    identical call."""
+    with _tags_lock:
+        last = _last_rounds.get(session)
+    if last is None:
+        raise RuntimeError(
+            f"async_rebuild({session!r}): no prior async_round to re-offer "
+            f"from on this driver"
+        )
+    keep = None if parties is None else set(parties)
+    objs = {
+        p: o for p, o in last["objs"].items()
+        if keep is None or p in keep
+    }
+    if not objs:
+        raise RuntimeError(
+            f"async_rebuild({session!r}): no surviving contributor to "
+            f"re-offer from (parties={sorted(keep or ())})"
+        )
+    weights = last["weights"]
+    if weights is not None:
+        weights = {p: w for p, w in weights.items() if p in objs}
+    return async_round(
+        objs,
+        round_tag=last["round_tag"],
+        root=new_root,
+        weights=weights,
+        session=session,
+        secure=last["secure"],
+    )
 
 
 def async_session_stats(

@@ -12,7 +12,7 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Party-local checkpoint / resume.
+"""Party-local checkpoint / resume, and the full-job consistent cut.
 
 The reference has NO checkpointing (SURVEY.md §5.4); job-level restart is
 only feasible there because seq ids are deterministic across re-runs. This
@@ -22,17 +22,99 @@ jax Arrays, plus the engine's seq-id counter) with orbax, and on restart
 every party restores its own snapshot and replays the driver program; the
 deterministic DAG numbering then lines the parties back up without any
 cross-party coordination.
+
+Two layers (docs/ha.md):
+
+- :func:`save_party_state` / :func:`restore_party_state` — the original
+  pytree-only snapshot (arrays via orbax, engine metadata alongside).
+- :func:`save_job_state` / :func:`restore_job_state` — one CONSISTENT
+  CUT of the whole control plane at a round boundary: model + optimizer
+  state (orbax), every async aggregator session's exported state
+  (buffer, staleness ledger, secure groups, published model), the
+  membership epoch/sync-index/term, the privacy ledger, and the
+  driver-side round-tag counters. The consistency contract: call it at
+  a round boundary AFTER resolving the round's handles on every party —
+  nothing is then in flight, so restoring the cut and replaying from
+  round N+1 continues aggregates bitwise (pinned by tests/test_ha.py).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Optional
+import pickle
+import shutil
+import threading
+from typing import Any, Dict, Optional
 
 from rayfed_tpu._private.global_context import get_global_context
 
 _META_FILE = "fed_meta.json"
+_CONTROL_FILE = "fed_control.pkl"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Job-checkpoint knobs (``config['checkpoint']``, validated at
+    ``fed.init`` like every other section; docs/ha.md).
+
+    Attributes:
+        base_dir: default directory :func:`save_job_state` /
+            :func:`restore_job_state` operate on when the caller passes
+            none. Each cut lands in ``<base_dir>/step_<N>``. None =
+            job-level checkpointing is explicit-path only.
+        keep: how many newest step dirs to retain after each save (older
+            complete cuts are pruned). 0 = keep everything.
+    """
+
+    base_dir: Optional[str] = None
+    keep: int = 3
+
+    def __post_init__(self) -> None:
+        if int(self.keep) < 0:
+            raise ValueError(
+                f"checkpoint.keep must be >= 0, got {self.keep}"
+            )
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "CheckpointConfig":
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(data) - field_names)
+        if unknown:
+            raise ValueError(
+                f"unknown checkpoint config key(s) {unknown}; known keys: "
+                f"{sorted(field_names)}"
+            )
+        return cls(**data)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_cfg_lock = threading.Lock()
+_default_cfg: Optional[CheckpointConfig] = None
+
+
+def set_default_checkpoint_config(data: Optional[Dict[str, Any]]) -> None:
+    """Validate and install ``config['checkpoint']`` (called by
+    ``fed.init``; raises on unknown keys so a typo rejects init)."""
+    global _default_cfg
+    cfg = CheckpointConfig.from_dict(data)
+    with _cfg_lock:
+        _default_cfg = cfg
+
+
+def get_default_checkpoint_config() -> CheckpointConfig:
+    with _cfg_lock:
+        return _default_cfg or CheckpointConfig()
+
+
+def reset_default_checkpoint_config() -> None:
+    global _default_cfg
+    with _cfg_lock:
+        _default_cfg = None
 
 
 def _checkpointer():
@@ -113,3 +195,188 @@ def latest_step(base_dir: str) -> Optional[int]:
 
 def step_dir(base_dir: str, step: int) -> str:
     return os.path.join(base_dir, f"step_{step}")
+
+
+# ---------------------------------------------------------------------------
+# Full-job consistent cut (docs/ha.md)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_base_dir(base_dir: Optional[str]) -> str:
+    base = base_dir or get_default_checkpoint_config().base_dir
+    if not base:
+        raise ValueError(
+            "no checkpoint directory: pass base_dir= or set "
+            'config["checkpoint"]["base_dir"] at fed.init'
+        )
+    return os.path.abspath(base)
+
+
+def _prune_steps(base: str, keep: int) -> None:
+    if keep <= 0:
+        return
+    steps = sorted(
+        int(name[5:])
+        for name in os.listdir(base)
+        if name.startswith("step_") and name[5:].isdigit()
+    )
+    for s in steps[:-keep]:
+        shutil.rmtree(os.path.join(base, f"step_{s}"), ignore_errors=True)
+
+
+def save_job_state(
+    base_dir: Optional[str] = None,
+    *,
+    step: int,
+    model: Any = None,
+    opt_state: Any = None,
+    extra: Any = None,
+) -> str:
+    """One consistent cut of this party's whole job state at round
+    boundary ``step``, under ``<base_dir>/step_<step>``.
+
+    The cut bundles: ``model`` + ``opt_state`` (+ JSON-free ``extra``
+    pytree) via orbax; every async aggregator session this party hosts
+    (exported buffer, staleness ledger, secure groups, published model);
+    the driver-side round-tag counters; the membership view / sync index
+    / term (when a manager is installed); and the privacy ledger (when a
+    privacy plane is installed). CONSISTENCY CONTRACT: call at a round
+    boundary after resolving the round's handles on EVERY party — with
+    nothing in flight, each party's local cut composes into one global
+    cut, and a restart resumes bitwise (tests/test_ha.py).
+
+    Returns the step directory written."""
+    import rayfed_tpu.async_rounds as async_rounds
+    from rayfed_tpu.membership.manager import get_membership_manager
+    from rayfed_tpu.privacy.manager import get_privacy_manager
+
+    base = _resolve_base_dir(base_dir)
+    path = step_dir(base, int(step))
+    os.makedirs(path, exist_ok=True)
+
+    arrays = {}
+    if model is not None:
+        arrays["model"] = model
+    if opt_state is not None:
+        arrays["opt_state"] = opt_state
+    if extra is not None:
+        arrays["extra"] = extra
+    if arrays:
+        ckpt = _checkpointer()
+        ckpt.save(os.path.join(path, "state"), arrays, force=True)
+        ckpt.wait_until_finished()
+
+    with async_rounds._sessions_lock:
+        session_names = list(async_rounds._sessions)
+    sessions = {
+        name: async_rounds._sessions[name].export_state()
+        for name in session_names
+    }
+    with async_rounds._tags_lock:
+        round_tags = dict(async_rounds._driver_round_tags)
+    membership = get_membership_manager()
+    privacy = get_privacy_manager()
+    control = {
+        "async_sessions": sessions,
+        "round_tags": round_tags,
+        "membership": (
+            membership.export_snapshot() if membership is not None else None
+        ),
+        "privacy_ledger": (
+            privacy.ledger_snapshot() if privacy is not None else None
+        ),
+    }
+    with open(os.path.join(path, _CONTROL_FILE), "wb") as f:
+        pickle.dump(control, f)
+
+    ctx = get_global_context()
+    meta = {
+        "step": int(step),
+        "party": ctx.get_current_party() if ctx else None,
+        "job": ctx.get_job_name() if ctx else None,
+        "seq_id_watermark": ctx.peek_seq_id() if ctx else None,
+        "kind": "job",
+        "has_arrays": sorted(arrays),
+        "sessions": sorted(sessions),
+        "membership_epoch": (
+            membership.current_epoch() if membership is not None else None
+        ),
+        "membership_term": (
+            membership.term() if membership is not None else None
+        ),
+    }
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(meta, f)
+    _prune_steps(base, int(get_default_checkpoint_config().keep))
+    return path
+
+
+def restore_job_state(
+    base_dir: Optional[str] = None,
+    *,
+    step: Optional[int] = None,
+    template: Any = None,
+    install: bool = True,
+) -> Dict[str, Any]:
+    """Reload a :func:`save_job_state` cut (the newest step when
+    ``step`` is None) and — with ``install=True`` — fast-forward the
+    running engine to it: every checkpointed aggregator session is
+    adopted into this party's registry, the driver round-tag counters
+    resume where they left off, the membership manager (when installed)
+    fast-forwards to the cut's epoch/sync index/term, and the privacy
+    ledger reloads its spent budget.
+
+    ``template`` restores the orbax arrays onto matching shardings; it
+    must mirror the saved ``{"model": ..., "opt_state": ...}`` shape.
+    Returns ``{"step", "path", "model", "opt_state", "extra", "meta"}``
+    (array entries None when the cut carried none)."""
+    import rayfed_tpu.async_rounds as async_rounds
+    from rayfed_tpu.membership.manager import get_membership_manager
+    from rayfed_tpu.privacy.manager import get_privacy_manager
+
+    base = _resolve_base_dir(base_dir)
+    if step is None:
+        step = latest_step(base)
+        if step is None:
+            raise FileNotFoundError(
+                f"no complete job checkpoint under {base!r}"
+            )
+    path = step_dir(base, int(step))
+    meta = load_meta(path)
+
+    arrays: Dict[str, Any] = {}
+    if meta.get("has_arrays"):
+        arrays = restore_party_state(path, template)
+
+    control: Dict[str, Any] = {}
+    control_path = os.path.join(path, _CONTROL_FILE)
+    if os.path.exists(control_path):
+        with open(control_path, "rb") as f:
+            control = pickle.load(f)
+
+    if install and control:
+        for name, state in (control.get("async_sessions") or {}).items():
+            agg = async_rounds._get_or_create_session(
+                name, state["cfg"], None
+            )
+            agg.adopt_state(state)
+        with async_rounds._tags_lock:
+            async_rounds._driver_round_tags.update(
+                control.get("round_tags") or {}
+            )
+        membership = get_membership_manager()
+        if membership is not None and control.get("membership"):
+            membership.restore_snapshot(control["membership"])
+        privacy = get_privacy_manager()
+        if privacy is not None and control.get("privacy_ledger"):
+            privacy.ledger_restore(control["privacy_ledger"])
+
+    return {
+        "step": int(step),
+        "path": path,
+        "model": arrays.get("model"),
+        "opt_state": arrays.get("opt_state"),
+        "extra": arrays.get("extra"),
+        "meta": meta,
+        "control": control,
+    }

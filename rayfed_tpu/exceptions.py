@@ -75,3 +75,22 @@ class FedActorKilledError(Exception):
     """Raised by method futures of an actor that was ``fed.kill``-ed before
     they could run (the analogue of Ray's RayActorError fail-fast semantics,
     ref ``fed/api.py:611-623``)."""
+
+
+class StaleCoordinatorError(Exception):
+    """A membership sync arrived from a deposed coordinator: its term is
+    below the term this party already adopted at a failover. The view it
+    carries was folded without the failover's evictions, so applying it
+    would fork the roster — every party rejects it instead (docs/ha.md).
+    """
+
+    def __init__(self, received_term: int, current_term: int,
+                 coordinator=None):
+        self.received_term = int(received_term)
+        self.current_term = int(current_term)
+        self.coordinator = coordinator
+        super().__init__(
+            f"stale membership sync from deposed coordinator "
+            f"{coordinator!r}: term {received_term} < adopted term "
+            f"{current_term}"
+        )

@@ -33,7 +33,7 @@ async plane (``BufferedAggregator`` drops offers stamped with
 evicted-epoch ghosts).
 """
 
-from rayfed_tpu.membership.config import MembershipConfig
+from rayfed_tpu.membership.config import FailoverConfig, MembershipConfig
 from rayfed_tpu.membership.coordinator import MembershipCoordinator
 from rayfed_tpu.membership.manager import (
     MembershipManager,
@@ -44,6 +44,7 @@ from rayfed_tpu.membership.manager import (
 from rayfed_tpu.membership.view import MembershipView
 
 __all__ = [
+    "FailoverConfig",
     "MembershipConfig",
     "MembershipCoordinator",
     "MembershipManager",

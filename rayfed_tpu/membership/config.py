@@ -21,6 +21,57 @@ from typing import Any, Dict, Optional
 
 
 @dataclasses.dataclass
+class FailoverConfig:
+    """Coordinator-failover knobs (``config['membership']['failover']``,
+    strict like the parent section; docs/ha.md).
+
+    Attributes:
+        enabled: let a non-coordinator party take over the coordinator
+            role when liveness declares the current coordinator DEAD
+            mid-sync. Requires a running liveness monitor — without one
+            every party reads ALIVE and failover never fires.
+        takeover_timeout_s: how long a member waits on the current
+            coordinator's sync broadcast before consulting liveness for
+            a DEAD verdict. Lower = faster failover, higher = fewer
+            spurious depositions on a slow-but-alive coordinator. The
+            overall ``sync_timeout_s`` still bounds the whole wait.
+        resync_window: how many recent agreed sync views each party
+            retains for takeover re-broadcast. A new coordinator re-sends
+            these VERBATIM under its term for members trailing at older
+            indices, so every sync index maps to exactly one view on
+            every party even across a failover.
+    """
+
+    enabled: bool = True
+    takeover_timeout_s: float = 5.0
+    resync_window: int = 2
+
+    def __post_init__(self) -> None:
+        if float(self.takeover_timeout_s) <= 0:
+            raise ValueError(
+                f"membership.failover.takeover_timeout_s must be > 0, got "
+                f"{self.takeover_timeout_s}"
+            )
+        if int(self.resync_window) < 1:
+            raise ValueError(
+                f"membership.failover.resync_window must be >= 1, got "
+                f"{self.resync_window}"
+            )
+
+    @classmethod
+    def from_dict(cls, data: Optional[Dict[str, Any]]) -> "FailoverConfig":
+        data = data or {}
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(data) - field_names)
+        if unknown:
+            raise ValueError(
+                f"unknown membership.failover config key(s) {unknown}; "
+                f"known keys: {sorted(field_names)}"
+            )
+        return cls(**data)
+
+
+@dataclasses.dataclass
 class MembershipConfig:
     """Elastic-membership knobs (``config['membership']``, validated at
     ``fed.init`` so a typo'd key rejects init, not the first sync;
@@ -51,6 +102,8 @@ class MembershipConfig:
             coordinator serves join bootstrap state from (the latest
             ``step_<N>`` snapshot) when the driver registered no
             bootstrap provider.
+        failover: nested :class:`FailoverConfig` (coordinator takeover
+            on a liveness DEAD verdict; docs/ha.md).
     """
 
     coordinator: Optional[str] = None
@@ -59,6 +112,7 @@ class MembershipConfig:
     join_timeout_s: float = 60.0
     sync_timeout_s: float = 60.0
     bootstrap_dir: Optional[str] = None
+    failover: FailoverConfig = dataclasses.field(default_factory=FailoverConfig)
 
     def __post_init__(self) -> None:
         if float(self.join_timeout_s) <= 0:
@@ -85,7 +139,18 @@ class MembershipConfig:
                 f"unknown membership config key(s) {unknown}; known keys: "
                 f"{sorted(field_names)}"
             )
-        return cls(**data)
+        kwargs = dict(data)
+        failover = kwargs.pop("failover", None)
+        if isinstance(failover, FailoverConfig):
+            kwargs["failover"] = failover
+        elif failover is not None:
+            if not isinstance(failover, dict):
+                raise ValueError(
+                    "membership.failover must be a dict, got "
+                    f"{type(failover).__name__}"
+                )
+            kwargs["failover"] = FailoverConfig.from_dict(failover)
+        return cls(**kwargs)
 
     def as_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

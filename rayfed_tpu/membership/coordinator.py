@@ -66,7 +66,19 @@ class MembershipCoordinator:
 
     def handle_control(self, header: Dict, value) -> Tuple[int, str]:
         """Dispatch one ``mbr:req:*`` control frame; the returned code
-        rides the frame's ack (403 fails the sender's future)."""
+        rides the frame's ack (403 fails the sender's future). A request
+        stamped with a HIGHER term than ours is proof we were deposed
+        while partitioned: demote and refuse, naming the successor."""
+        if isinstance(value, dict):
+            req_term = int(value.get("term") or 0)
+            if req_term > self._manager.term():
+                self._manager.adopt_term(req_term, None)
+                if not self._manager.is_coordinator():
+                    return CODE_FORBIDDEN, (
+                        f"this party was deposed as coordinator at term "
+                        f"{req_term}; re-offer the request to "
+                        f"{self._manager.coordinator()!r}"
+                    )
         up = header.get("up", "")
         if up == protocol.JOIN_REQ_SEQ:
             return self._handle_join(value)
@@ -197,18 +209,25 @@ class MembershipCoordinator:
             for p in admitted:
                 admissions_tbl[p] = new_view.epoch
                 evictions_tbl.pop(p, None)
+        term = manager.term()
         msg = protocol.make_sync(
             new_view.to_wire(), sync_index,
             admitted if changed else {}, evicted_stamp,
             admissions_tbl, evictions_tbl,
+            term=term, coordinator=manager.self_party,
         )
         # Broadcast to the OLD roster (minus self, minus the removed):
-        # those parties are parked at the same sync point. Joiners learn
-        # the view from their JoinAccept instead.
+        # those parties are parked at the same sync point; post-failover
+        # terms qualify the key so a deposed predecessor's frame can
+        # never have consumed the slot. Joiners learn the view from
+        # their JoinAccept instead.
+        down_key = protocol.sync_down_key(sync_index, term)
         for p in old_view.roster:
             if p == manager.self_party or p in remove:
                 continue
-            barriers.send(p, msg, protocol.SYNC_SEQ, str(sync_index))
+            barriers.send(p, msg, protocol.SYNC_SEQ, down_key)
+        with manager._lock:
+            manager._record_sync_locked(sync_index, msg)
         if changed:
             applied = manager.apply_sync_msg(msg)
             with self._lock:
@@ -228,6 +247,7 @@ class MembershipCoordinator:
                     protocol.make_join_accept(
                         applied.to_wire(), sync_index,
                         admissions_tbl, evictions_tbl, bootstrap,
+                        term=term,
                     ),
                     protocol.RESPONSE_SEQ,
                     j["nonce"],
@@ -238,3 +258,48 @@ class MembershipCoordinator:
                     0, time.perf_counter(), event="admit",
                 )
         return applied
+
+    # -- liveness-triggered takeover (HA) ------------------------------
+
+    def run_takeover(self, sync_index: int):
+        """First sync after this party won a failover election. Before
+        the term-``sync_index`` fold, re-broadcast the retained recent
+        sync views VERBATIM (term restamped) at their new-term keys:
+        a member whose previous recv failed rolled its index back and is
+        re-waiting an OLDER sync point — it must receive the exact view
+        the old coordinator agreed there, not our post-takeover fold,
+        or rosters diverge per-round across the fleet. Then fold at
+        ``sync_index`` as usual, which lands the deposed predecessor's
+        eviction and replays every re-offered join/leave."""
+        from rayfed_tpu.proxy import barriers
+
+        manager = self._manager
+        term = manager.term()
+        recent = manager.recent_syncs()
+        roster = manager.roster()
+        with self._lock:
+            pending_remove = set(self._pending_leaves) | set(
+                self._pending_evictions
+            )
+        for idx in sorted(recent):
+            if idx >= sync_index:
+                continue
+            msg = dict(recent[idx])
+            msg["term"] = term
+            msg["coordinator"] = manager.self_party
+            down_key = protocol.sync_down_key(idx, term)
+            for p in roster:
+                if p == manager.self_party or p in pending_remove:
+                    continue
+                barriers.send(p, msg, protocol.SYNC_SEQ, down_key)
+        tracing.record(
+            "failover", manager.self_party, f"sync:{sync_index}",
+            f"term:{term}", 0, time.perf_counter(), event="takeover",
+            resync=sorted(i for i in recent if i < sync_index),
+        )
+        logger.warning(
+            "membership takeover: %r coordinating from sync %d at term "
+            "%d (re-broadcast %s)", manager.self_party, sync_index, term,
+            sorted(i for i in recent if i < sync_index),
+        )
+        return self.run_sync(sync_index)

@@ -46,6 +46,7 @@ import logging
 import pickle
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import rayfed_tpu._private.constants as constants
@@ -53,6 +54,7 @@ import rayfed_tpu.config as fed_config
 from rayfed_tpu import tracing
 from rayfed_tpu._private import kv as internal_kv
 from rayfed_tpu._private.global_context import get_global_context
+from rayfed_tpu.exceptions import StaleCoordinatorError
 from rayfed_tpu.membership import protocol
 from rayfed_tpu.membership.config import MembershipConfig
 from rayfed_tpu.membership.view import MembershipView
@@ -67,6 +69,20 @@ _m_epoch = telemetry_metrics.get_registry().gauge(
 _m_roster_size = telemetry_metrics.get_registry().gauge(
     "fed_membership_roster_size",
     "Parties in this party's applied roster.",
+)
+_m_term = telemetry_metrics.get_registry().gauge(
+    "fed_membership_coordinator_term",
+    "This party's adopted coordinator term (0 = configured coordinator, "
+    "bumped once per failover).",
+)
+_m_failovers = telemetry_metrics.get_registry().counter(
+    "fed_membership_failovers_total",
+    "Coordinator depositions this party adopted (term bumps).",
+)
+_m_stale_syncs = telemetry_metrics.get_registry().counter(
+    "fed_membership_stale_syncs_rejected_total",
+    "Sync broadcasts rejected because their term predates the adopted "
+    "term (a deposed coordinator's stale view).",
 )
 
 
@@ -93,6 +109,7 @@ class MembershipManager:
         sync_index: int = 0,
         admissions: Optional[Dict[str, int]] = None,
         evictions: Optional[Dict[str, int]] = None,
+        term: int = 0,
     ) -> None:
         self._job_name = job_name
         self._self_party = self_party
@@ -102,6 +119,30 @@ class MembershipManager:
         _m_epoch.set(view.epoch)
         _m_roster_size.set(len(view.roster))
         self._sync_index = int(sync_index)
+        # Coordinator term (HA): bumped once per failover, carried in
+        # every sync/request frame and in the sync rendezvous key. The
+        # deposed chain records every coordinator this party stopped
+        # trusting; elections pick sorted(roster - deposed)[0], which is
+        # deterministic because liveness never enters the CHOICE — it
+        # only decides WHEN a member gives up on the current holder.
+        self._term = int(term)
+        _m_term.set(self._term)
+        self._deposed: set = set()
+        # Recent agreed sync broadcasts ({sync_index: msg}, bounded by
+        # failover.resync_window): a takeover coordinator re-sends these
+        # VERBATIM (term restamped) for members trailing at older
+        # indices, so every sync index maps to one view on every party.
+        self._recent_syncs: Dict[int, Dict] = {}
+        self._ha_stats: Dict[str, int] = {
+            "failovers": 0,
+            "takeovers": 0,
+            "stale_syncs_rejected": 0,
+        }
+        # In-flight sync/takeover counter: fed.shutdown drains this so a
+        # job shutting down during a failover exits cleanly instead of
+        # tearing proxies out from under a mid-broadcast takeover.
+        self._inflight = 0
+        self._drain_cond = threading.Condition(self._lock)
         # Ghost tables. A party's ADMISSION epoch is the epoch of the
         # bump that added it (0 for the initial roster); its EVICTION
         # epoch is the epoch as of which it is out. An offer stamped
@@ -155,13 +196,22 @@ class MembershipManager:
             return self._sync_index
 
     def coordinator(self) -> str:
-        return self._coordinator_name
+        with self._lock:
+            return self._coordinator_name
 
     def is_coordinator(self) -> bool:
         return self._coordinator is not None
 
     def get_coordinator_state(self):
         return self._coordinator
+
+    def term(self) -> int:
+        with self._lock:
+            return self._term
+
+    def ha_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._ha_stats)
 
     def is_ghost(self, party: str, epoch: Optional[int]) -> bool:
         """True when an offer stamped ``epoch`` from ``party`` belongs to
@@ -208,16 +258,25 @@ class MembershipManager:
 
     def set_bootstrap_provider(self, fn: Optional[Callable[[], Any]]) -> None:
         """Register the callable whose return value rides each
-        JoinAccept as the joiner's bootstrap state (e.g. the current
-        global model + round index). Overrides the ``bootstrap_dir``
-        checkpoint fallback and the live ModelBank fallback."""
+        JoinAccept as the joiner's bootstrap state. Return BOTH the
+        model and the optimizer state (e.g. ``{"model": params,
+        "opt_state": opt_state, "round": r}``) — a replacement party
+        bootstrapped without optimizer state resumes inference, not
+        training. Overrides the ``bootstrap_dir`` checkpoint fallback
+        and the live ModelBank fallback."""
         self._bootstrap_provider = fn
 
     def make_bootstrap(self) -> Any:
         """Bootstrap state for a JoinAccept, by priority: the registered
         provider, else the newest ``checkpoint.py`` snapshot under
         ``membership.bootstrap_dir``, else the newest live ModelBank
-        version on this party, else None."""
+        version on this party, else None.
+
+        The checkpoint kind INLINES the snapshot's model and optimizer
+        state (plus the pointer for anything else in the cut): a
+        replacement joiner must resume training from the same optimizer
+        trajectory, not restart momentum from zero against a trained
+        model."""
         if self._bootstrap_provider is not None:
             return {"kind": "provider", "state": self._bootstrap_provider()}
         if self._config.bootstrap_dir:
@@ -226,14 +285,39 @@ class MembershipManager:
 
                 step = checkpoint.latest_step(self._config.bootstrap_dir)
                 if step is not None:
-                    return {
+                    path = checkpoint.step_dir(
+                        self._config.bootstrap_dir, step
+                    )
+                    payload = {
                         "kind": "checkpoint",
                         "base_dir": self._config.bootstrap_dir,
                         "step": int(step),
-                        "path": checkpoint.step_dir(
-                            self._config.bootstrap_dir, step
-                        ),
+                        "path": path,
                     }
+                    try:
+                        meta = checkpoint.load_meta(path)
+                        if meta.get("kind") == "job":
+                            restored = checkpoint.restore_job_state(
+                                self._config.bootstrap_dir, step=int(step),
+                                install=False,
+                            )
+                            payload["model"] = restored["model"]
+                            payload["opt_state"] = restored["opt_state"]
+                        else:
+                            state = checkpoint.restore_party_state(path)
+                            if isinstance(state, dict):
+                                payload["model"] = state.get("model", state)
+                                payload["opt_state"] = state.get("opt_state")
+                            else:
+                                payload["model"] = state
+                    except Exception:  # noqa: BLE001 - pointer-only
+                        # fallback: the joiner can still read the dir
+                        logger.warning(
+                            "membership: could not inline checkpoint "
+                            "bootstrap state (sending pointer only)",
+                            exc_info=True,
+                        )
+                    return payload
             except Exception:  # noqa: BLE001 - bootstrap is best-effort
                 logger.warning(
                     "membership: checkpoint bootstrap lookup failed",
@@ -310,22 +394,76 @@ class MembershipManager:
         with self._lock:
             self._sync_index += 1
             idx = self._sync_index
-        if self._coordinator is not None:
-            return self._coordinator.run_sync(idx)
-        from rayfed_tpu.proxy import barriers
-
-        fut = barriers.recv(
-            self._self_party,
-            self._coordinator_name,
-            protocol.SYNC_SEQ,
-            str(idx),
-        )
+            self._inflight += 1
         try:
-            msg = fut.result(
-                timeout=timeout
-                if timeout is not None
-                else self._config.sync_timeout_s
-            )
+            if self._coordinator is not None:
+                return self._coordinator.run_sync(idx)
+            return self._member_sync(idx, timeout)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._drain_cond.notify_all()
+
+    def _member_sync(
+        self, idx: int, timeout: Optional[float]
+    ) -> MembershipView:
+        """The member side of one sync: wait on the coordinator's
+        broadcast in ``failover.takeover_timeout_s`` slices; when a slice
+        expires AND liveness says the coordinator is DEAD, depose it,
+        adopt the next term, and either promote (we are the deterministic
+        successor) or re-park at the successor's term-qualified key. The
+        overall ``sync_timeout_s`` still bounds the whole wait, and a
+        final failure still rolls the sync index back so a retry re-waits
+        the SAME sync point."""
+        from rayfed_tpu.proxy import barriers
+        from rayfed_tpu.resilience import liveness
+
+        fo = self._config.failover
+        total = timeout if timeout is not None else self._config.sync_timeout_s
+        deadline = time.monotonic() + total
+        fut = None
+        fut_key = None
+        try:
+            while True:
+                with self._lock:
+                    coord = self._coordinator_name
+                    term = self._term
+                key = protocol.sync_down_key(idx, term)
+                if fut_key != (coord, key):
+                    # One parked waiter per (coordinator, key): only a
+                    # term change re-parks, so waiters never pile up.
+                    fut = barriers.recv(
+                        self._self_party, coord, protocol.SYNC_SEQ, key
+                    )
+                    fut_key = (coord, key)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise FuturesTimeout(
+                        f"membership sync {idx} timed out after {total}s "
+                        f"(coordinator {coord!r}, term {term})"
+                    )
+                slice_s = remaining
+                if fo.enabled:
+                    slice_s = min(remaining, float(fo.takeover_timeout_s))
+                try:
+                    msg = fut.result(timeout=slice_s)
+                except (FuturesTimeout, TimeoutError):
+                    # Slice expired — or the rendezvous store expired the
+                    # parked waiter at its own recv deadline (the future
+                    # itself failed; a fresh recv re-parks it).
+                    if fut.done():
+                        fut_key = None
+                    if (
+                        fo.enabled
+                        and liveness.party_state(coord) == liveness.DEAD
+                    ):
+                        self._failover_elect(coord)
+                        if self._coordinator is not None:
+                            return self._coordinator.run_takeover(idx)
+                    continue
+                with self._lock:
+                    self._record_sync_locked(idx, msg)
+                return self.apply_sync_msg(msg)
         except BaseException:
             # The sync did NOT land: roll the index back so a retry
             # re-waits the SAME key (the coordinator's broadcast for it
@@ -336,9 +474,230 @@ class MembershipManager:
                 if self._sync_index == idx:
                     self._sync_index = idx - 1
             raise
-        return self.apply_sync_msg(msg)
+
+    # -- coordinator failover ------------------------------------------
+
+    def _record_sync_locked(self, idx: int, msg: Dict) -> None:
+        self._recent_syncs[int(idx)] = msg
+        window = int(self._config.failover.resync_window)
+        for old in sorted(self._recent_syncs):
+            if len(self._recent_syncs) <= window:
+                break
+            del self._recent_syncs[old]
+
+    def recent_syncs(self) -> Dict[int, Dict]:
+        with self._lock:
+            return dict(self._recent_syncs)
+
+    def _failover_elect(self, dead_coord: str) -> str:
+        """Depose ``dead_coord``: adopt the next term and elect the
+        deterministic successor — sorted(roster − deposed chain)[0].
+        Liveness gates WHEN this runs, never WHO wins, so every survivor
+        that deposes term T elects the identical term-T+1 coordinator
+        without a message. Promotes this party (control handler, DEAD
+        escalation, eviction of the deposed holder) when the election
+        lands on us. Returns the successor's name."""
+        from rayfed_tpu.proxy import rendezvous
+        from rayfed_tpu.resilience import liveness
+
+        promote = False
+        with self._lock:
+            if self._coordinator_name != dead_coord:
+                return self._coordinator_name
+            self._deposed.add(dead_coord)
+            candidates = sorted(set(self._view.roster) - self._deposed)
+            if not candidates:
+                raise RuntimeError(
+                    "membership failover: no candidate left for the "
+                    "coordinator role (every roster party is deposed)"
+                )
+            old_term = self._term
+            self._term += 1
+            self._coordinator_name = candidates[0]
+            successor = self._coordinator_name
+            self._ha_stats["failovers"] += 1
+            _m_term.set(self._term)
+            _m_failovers.inc()
+            if successor == self._self_party and self._coordinator is None:
+                from rayfed_tpu.membership.coordinator import (
+                    MembershipCoordinator,
+                )
+
+                self._coordinator = MembershipCoordinator(self)
+                self._ha_stats["takeovers"] += 1
+                promote = True
+            new_term = self._term
+        tracing.record(
+            "failover", dead_coord, f"term:{old_term}", f"term:{new_term}",
+            0, time.perf_counter(), event="depose", successor=successor,
+        )
+        logger.warning(
+            "membership failover: coordinator %r is DEAD — term %d -> %d, "
+            "successor %r%s", dead_coord, old_term, new_term, successor,
+            " (this party takes over)" if promote else "",
+        )
+        if promote:
+            coordinator = self._coordinator
+            rendezvous.set_control_handler(
+                self._job_name, coordinator.handle_control
+            )
+            monitor = liveness.get_monitor()
+            if monitor is not None and self._config.evict_dead:
+                monitor.set_on_dead(coordinator.note_dead)
+            if self._config.evict_dead:
+                # The deposed holder leaves the roster at our first sync
+                # as coordinator — the takeover bump.
+                coordinator.note_dead(dead_coord)
+        return successor
+
+    def adopt_term(self, term: int, coordinator: Optional[str]) -> None:
+        """Adopt a HIGHER term learned from a frame (a sync or request
+        stamped ahead of us): record the deposition we missed and track
+        the sender's coordinator. A coordinator that learns of its own
+        deposition this way demotes — it stops folding; its own stale
+        broadcasts are rejected by every member's term check anyway."""
+        with self._lock:
+            if int(term) <= self._term:
+                return
+            old_term = self._term
+            self._term = int(term)
+            _m_term.set(self._term)
+            _m_failovers.inc()
+            self._ha_stats["failovers"] += 1
+            if coordinator is None:
+                # The frame proves a deposition happened but not who
+                # won: depose the current holder and elect from the
+                # chain — the same deterministic choice the deposers
+                # made, so it names the same winner.
+                self._deposed.add(self._coordinator_name)
+                candidates = sorted(set(self._view.roster) - self._deposed)
+                coordinator = (
+                    candidates[0] if candidates else self._self_party
+                )
+            demoted = False
+            if coordinator != self._self_party:
+                if self._coordinator_name != coordinator:
+                    self._deposed.add(self._coordinator_name)
+                    self._coordinator_name = coordinator
+                demoted = self._coordinator is not None
+                if demoted:
+                    self._coordinator = None
+        if demoted:
+            from rayfed_tpu.proxy import rendezvous
+
+            rendezvous.clear_control_handler(self._job_name)
+            logger.warning(
+                "membership failover: this party was deposed as "
+                "coordinator (term %d -> %d, successor %r)",
+                old_term, term, coordinator,
+            )
+        else:
+            logger.info(
+                "membership failover: adopted term %d (coordinator %r)",
+                term, coordinator,
+            )
+
+    # -- checkpoint cut (docs/ha.md) -----------------------------------
+
+    def export_snapshot(self) -> Dict[str, Any]:
+        """This party's membership state for a job checkpoint cut: the
+        agreed view, the never-reset sync index, the adopted term and
+        deposed chain, and the full ghost tables. Wire/JSON-clean."""
+        with self._lock:
+            return {
+                "view": self._view.to_wire(),
+                "sync_index": self._sync_index,
+                "term": self._term,
+                "deposed": sorted(self._deposed),
+                "admissions": dict(self._admissions),
+                "evictions": dict(self._evictions),
+            }
+
+    def restore_snapshot(self, snap: Dict[str, Any]) -> None:
+        """Fast-forward this manager to a checkpointed cut. Only state
+        AT or AHEAD of ours applies (sync index, term, epoch) — a
+        restart re-inits at epoch 0/term 0 and then replays the cut, so
+        every restored party resumes with the identical epoch stamp,
+        sync key, and ghost tables it checkpointed with."""
+        view = MembershipView.from_wire(snap["view"])
+        promote = False
+        with self._lock:
+            if int(snap.get("sync_index", 0)) > self._sync_index:
+                self._sync_index = int(snap["sync_index"])
+            if int(snap.get("term", 0)) > self._term:
+                self._term = int(snap["term"])
+                _m_term.set(self._term)
+            self._deposed |= set(snap.get("deposed") or ())
+            if self._term > 0:
+                # Post-failover cut: the election result, not the
+                # configured name, is the coordinator going forward.
+                candidates = sorted(set(view.roster) - self._deposed)
+                if candidates:
+                    self._coordinator_name = candidates[0]
+            if view.epoch > self._view.epoch:
+                self._apply_bump_locked(
+                    view, {}, {},
+                    snap.get("admissions"), snap.get("evictions"),
+                )
+            else:
+                self._admissions.update(
+                    {p: int(e) for p, e in
+                     (snap.get("admissions") or {}).items()}
+                )
+                self._evictions.update(
+                    {p: int(e) for p, e in
+                     (snap.get("evictions") or {}).items()}
+                )
+            if (
+                self._coordinator_name == self._self_party
+                and self._coordinator is None
+            ):
+                from rayfed_tpu.membership.coordinator import (
+                    MembershipCoordinator,
+                )
+
+                self._coordinator = MembershipCoordinator(self)
+                promote = True
+        if promote:
+            # Re-run the coordinator half of install(): the cut says the
+            # role migrated to this party before the checkpoint.
+            self.install()
+        logger.info(
+            "membership: restored checkpoint cut (epoch %d, sync %d, "
+            "term %d)", self.current_epoch(), self.sync_index(),
+            self.term(),
+        )
+
+    def drain_takeover(self, timeout: float = 2.0) -> bool:
+        """Block until no membership sync / takeover is in flight (or
+        the timeout lapses). ``fed.shutdown`` calls this before tearing
+        the membership plane down so a mid-takeover broadcast finishes
+        against live proxies. Returns True when quiescent."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._lock:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._drain_cond.wait(remaining)
+            return True
 
     def apply_sync_msg(self, msg: Dict) -> MembershipView:
+        # Term fencing FIRST: a deposed coordinator's sync was folded
+        # without the failover's evictions — applying it would fork the
+        # roster. (The rendezvous key already keeps it from consuming
+        # the live broadcast's slot; this rejects one handed to us
+        # directly.) A HIGHER term is a failover we missed: adopt it.
+        msg_term = int(msg.get("term") or 0)
+        with self._lock:
+            if msg_term < self._term:
+                self._ha_stats["stale_syncs_rejected"] += 1
+                _m_stale_syncs.inc()
+                raise StaleCoordinatorError(
+                    msg_term, self._term, msg.get("coordinator")
+                )
+        if msg_term > self.term():
+            self.adopt_term(msg_term, msg.get("coordinator"))
         new_view = MembershipView.from_wire(msg["view"])
         admitted = dict(msg.get("admitted") or {})
         evicted = {
@@ -392,6 +751,10 @@ class MembershipManager:
         self._view = new_view
         _m_epoch.set(new_view.epoch)
         _m_roster_size.set(len(new_view.roster))
+        # A re-admitted party is a fresh incarnation: make it electable
+        # again (the deposed chain fences the DEAD incarnation, not the
+        # name forever).
+        self._deposed -= set(admitted)
 
         from rayfed_tpu.proxy import barriers, rendezvous
 
@@ -494,27 +857,52 @@ class MembershipManager:
                 "with a different membership.coordinator)"
             )
         from rayfed_tpu.proxy import barriers
+        from rayfed_tpu.resilience import liveness
 
-        nonce = protocol.new_nonce()
-        fut = barriers.send(
-            self._coordinator_name,
-            protocol.make_leave_request(self._self_party, nonce),
-            protocol.LEAVE_REQ_SEQ,
-            nonce,
+        timeout = (
+            timeout if timeout is not None else self._config.sync_timeout_s
         )
+        nonce = protocol.new_nonce()
+        coord = self.coordinator()
         try:
-            fut.result(
-                timeout=timeout
-                if timeout is not None
-                else self._config.sync_timeout_s
-            )
+            barriers.send(
+                coord,
+                protocol.make_leave_request(
+                    self._self_party, nonce, term=self.term()
+                ),
+                protocol.LEAVE_REQ_SEQ,
+                nonce,
+            ).result(timeout=timeout)
         except Exception:  # noqa: BLE001 - departure is best-effort: an
-            # unreachable coordinator will evict us via liveness anyway
-            logger.warning(
-                "membership: leave notification to coordinator %s failed "
-                "(liveness eviction will reap this party instead)",
-                self._coordinator_name, exc_info=True,
-            )
+            # unreachable coordinator will evict us via liveness anyway.
+            # Re-offer once against the failover successor first — the
+            # takeover replays membership intent from exactly these
+            # re-offered requests (docs/ha.md).
+            reoffered = False
+            if (
+                self._config.failover.enabled
+                and liveness.party_state(coord) == liveness.DEAD
+            ):
+                successor = self._failover_elect(coord)
+                if successor not in (coord, self._self_party):
+                    try:
+                        barriers.send(
+                            successor,
+                            protocol.make_leave_request(
+                                self._self_party, nonce, term=self.term()
+                            ),
+                            protocol.LEAVE_REQ_SEQ,
+                            nonce,
+                        ).result(timeout=timeout)
+                        reoffered = True
+                    except Exception:  # noqa: BLE001 - same best-effort
+                        pass
+            if not reoffered:
+                logger.warning(
+                    "membership: leave notification to coordinator %s "
+                    "failed (liveness eviction will reap this party "
+                    "instead)", coord, exc_info=True,
+                )
         tracing.record(
             "membership", self._self_party,
             f"epoch:{self.current_epoch()}", "left", 0,
@@ -583,6 +971,7 @@ def join_handshake(
         sync_index=int(accept["sync_index"]),
         admissions=accept.get("admissions") or {},
         evictions=accept.get("evictions") or {},
+        term=int(accept.get("term") or 0),
     )
     # Admit the full roster locally: addresses into the KV config and
     # the sender proxy, peers into the liveness monitor.

@@ -62,8 +62,23 @@ def new_nonce() -> str:
     return uuid.uuid4().hex
 
 
+def sync_down_key(sync_index: int, term: int) -> str:
+    """Rendezvous down-key for the sync broadcast at ``sync_index`` under
+    coordinator ``term``. Term 0 (the configured coordinator, no failover
+    yet) keeps the bare index so pre-HA peers interoperate; any later
+    term qualifies the key. The qualification is what fences a deposed
+    coordinator at the STORE: rendezvous keys are ``(up, down)`` only and
+    delivered keys are tombstoned against duplicates, so a stale term-T
+    sync parked (or expired) at its own key can never consume the slot
+    the term-T+1 broadcast must land in."""
+    return str(int(sync_index)) if int(term) <= 0 else (
+        f"{int(sync_index)}t{int(term)}"
+    )
+
+
 def make_join_request(
-    party: str, address: str, nonce: str, token: Optional[str]
+    party: str, address: str, nonce: str, token: Optional[str],
+    term: int = 0,
 ) -> Dict:
     return {
         "kind": "join",
@@ -71,11 +86,12 @@ def make_join_request(
         "address": address,
         "nonce": nonce,
         "token": token,
+        "term": int(term),
     }
 
 
-def make_leave_request(party: str, nonce: str) -> Dict:
-    return {"kind": "leave", "party": party, "nonce": nonce}
+def make_leave_request(party: str, nonce: str, term: int = 0) -> Dict:
+    return {"kind": "leave", "party": party, "nonce": nonce, "term": int(term)}
 
 
 def make_join_accept(
@@ -84,6 +100,7 @@ def make_join_accept(
     admissions: Dict[str, int],
     evictions: Dict[str, int],
     bootstrap: Any,
+    term: int = 0,
 ) -> Dict:
     return {
         "kind": "join-accept",
@@ -92,6 +109,7 @@ def make_join_accept(
         "admissions": dict(admissions),
         "evictions": dict(evictions),
         "bootstrap": bootstrap,
+        "term": int(term),
     }
 
 
@@ -102,6 +120,8 @@ def make_sync(
     evicted: Dict[str, int],
     admissions: Optional[Dict[str, int]] = None,
     evictions: Optional[Dict[str, int]] = None,
+    term: int = 0,
+    coordinator: Optional[str] = None,
 ) -> Dict:
     """The per-sync view broadcast. ``admitted`` maps parties admitted at
     THIS bump to their addresses; ``evicted`` maps parties removed at
@@ -109,7 +129,9 @@ def make_sync(
     ``admissions``/``evictions`` are the coordinator's FULL ghost tables
     after the bump — they make every sync self-contained, so a member
     that missed an intermediate sync (recv timed out, frame lost) still
-    reconciles to the complete state instead of just this bump's delta."""
+    reconciles to the complete state instead of just this bump's delta.
+    ``term`` is the sender's coordinator term; receivers reject any sync
+    whose term is below their own (a deposed coordinator's stale view)."""
     return {
         "kind": "sync",
         "view": view_wire,
@@ -118,4 +140,6 @@ def make_sync(
         "evicted": dict(evicted),
         "admissions": dict(admissions) if admissions is not None else None,
         "evictions": dict(evictions) if evictions is not None else None,
+        "term": int(term),
+        "coordinator": coordinator,
     }

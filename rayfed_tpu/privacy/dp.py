@@ -142,3 +142,12 @@ class PrivacyLedger:
                 }
                 for p in sorted(self._epsilon)
             }
+
+    def restore(self, snapshot: Optional[Dict[str, Dict]]) -> None:
+        """Reload a :meth:`snapshot` (a job checkpoint cut): the spent
+        budget must survive a restart — a ledger that resets with the
+        process would under-count every pre-crash round's epsilon."""
+        with self._lock:
+            for p, rec in (snapshot or {}).items():
+                self._rounds[p] = int(rec.get("rounds", 0))
+                self._epsilon[p] = float(rec.get("epsilon", 0.0))

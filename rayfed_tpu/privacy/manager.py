@@ -435,6 +435,13 @@ class PrivacyManager:
     def ledger_snapshot(self) -> Dict[str, Dict[str, float]]:
         return self.ledger.snapshot()
 
+    def ledger_restore(self, snapshot: Optional[Dict[str, Dict]]) -> None:
+        """Reload a checkpointed ledger snapshot (job restore) and
+        refresh the per-party epsilon gauges from it."""
+        self.ledger.restore(snapshot)
+        for p, rec in (snapshot or {}).items():
+            _m_epsilon.labels(party=p).set(float(rec.get("epsilon", 0.0)))
+
 
 # ---------------------------------------------------------------------------
 # Process singleton + install/uninstall (fed.init / fed.shutdown)

@@ -56,6 +56,7 @@ DecodeFn = Callable[[Dict, memoryview], object]
 #: a non-coordinator and a telemetry push sent to a non-collector both
 #: earn the same explicit refusal instead of wedging in ``_arrived``.
 CONTROL_SEQ_PREFIX = "mbr:req:"    # membership control (membership/protocol.py)
+MEMBERSHIP_SEQ_PREFIX = "mbr:"     # stored membership frames (sync, rsp)
 TELEMETRY_SEQ_PREFIX = "tel:"      # telemetry agent pushes (telemetry/agent.py)
 PRIVACY_SEQ_PREFIX = "prv:"        # privacy plane (privacy/protocol.py)
 CONTROL_NAMESPACES: Tuple[str, ...] = (
@@ -394,8 +395,21 @@ class RendezvousStore:
                         if waiter is not None:
                             # Tombstone: a slow (not dead) peer's frame
                             # arriving after expiry must be acked-and-
-                            # dropped like a duplicate, not parked forever.
-                            self._mark_consumed(key)
+                            # dropped like a duplicate, not parked forever
+                            # (data seq ids are monotonic — no consumer
+                            # ever re-takes an expired one). Membership
+                            # keys are EXEMPT: a member re-takes the SAME
+                            # sync key after an expiry (sync-index
+                            # rollback, takeover re-broadcast), so the
+                            # late frame must still park and match the
+                            # re-parked waiter — a tombstone here wedges
+                            # coordinator failover. Lingering mbr frames
+                            # are bounded (resync_window per takeover)
+                            # and reaped by the eviction sweep below.
+                            if not str(key[0]).startswith(
+                                MEMBERSHIP_SEQ_PREFIX
+                            ):
+                                self._mark_consumed(key)
                             expired.append((key, waiter))
             for key, waiter in expired:
                 waiter.set_exception(

@@ -143,3 +143,45 @@ class ModelBank:
     def swap_count(self) -> int:
         with self._lock:
             return len(self._swap_log)
+
+    # -- state handoff (HA, docs/ha.md) -------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """The current version + snapshot (and its extras), for handing
+        the serving role to a successor party or a checkpoint cut.
+        In-flight pins and retired versions stay behind — a successor
+        serves the newest generation; it cannot adopt another process's
+        refcounts."""
+        with self._lock:
+            if self._current == 0:
+                return {"version": 0, "params": None, "extras": {}}
+            return {
+                "version": self._current,
+                "params": self._snapshots[self._current],
+                "extras": dict(self._extras.get(self._current, {})),
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> int:
+        """Adopt an :meth:`export_state` snapshot: install its params
+        and CONTINUE its version numbering, so readers that pinned
+        "version N" semantics across the handoff observe a
+        monotonically increasing sequence. No-op at version 0."""
+        version = int(state.get("version") or 0)
+        if version <= 0 or state.get("params") is None:
+            return self.current_version()
+        snap = snapshot_tree(state["params"])
+        extra_snap = {
+            k: snapshot_tree(v)
+            for k, v in (state.get("extras") or {}).items()
+            if v is not None
+        }
+        with self._lock:
+            if version <= self._current:
+                return self._current
+            self._snapshots[version] = snap
+            self._extras[version] = extra_snap
+            self._refs.setdefault(version, 0)
+            self._current = version
+            self._swap_log.append((version, time.perf_counter()))
+            self._retire_locked()
+        return version

@@ -72,6 +72,9 @@ import pytest  # noqa: E402
 _SLOW_TESTS = {
     "test_churn_chaos_replace_dead_party",
     "test_join_leave_lifecycle",
+    "test_coordinator_failover_mid_round",
+    "test_async_root_killed_rebuild_publishes",
+    "test_job_checkpoint_restart_bitwise",
     "test_dryrun_multichip_under_driver_conditions",
     "test_federated_lora_round",
     "test_1f1b_loss_and_grads_match_gpipe",

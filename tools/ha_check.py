@@ -1,0 +1,140 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Control-plane HA gate (docs/ha.md).
+
+Runs bench.py's 3-party HA stage (spawned processes, real TCP
+transport): the CONFIGURED COORDINATOR (alice) is crash-killed
+mid-sync-broadcast by an injected fault; the deterministic successor
+(bob) deposes it on the liveness DEAD verdict, adopts term 1, and takes
+over the sync point — re-broadcasting the retained recent views so the
+member whose recv the crash orphaned converges on the same roster.
+FAILS LOUDLY — exit code 1 — when failover starts costing training
+rounds or the takeover stall regresses. Wire this into CI so a change
+that quietly breaks the election (a successor that never promotes, a
+term fence that stops rejecting the deposed holder's frames, a takeover
+re-broadcast that no longer lands) turns the build red.
+
+Three gates:
+
+  failover_ms — ``coordinator_failover_ms`` (the longest
+                membership_sync wait the successor paid: DEAD verdict +
+                deterministic election + takeover re-broadcast) must
+                stay under budget. Measured ~2-4 s on a quiet host
+                (one liveness escalation + one fed.get timeout on the
+                dead coordinator's last round); the default 15 s
+                ceiling catches the pathological regressions — a
+                takeover serialized behind sync_timeout_s, or a member
+                stuck waiting a re-broadcast that never arrives.
+  rounds_lost — ``ha_rounds_lost`` must stay <= the budget (default 0:
+                failover must DEGRADE rounds — fewer contributors —
+                never lose them outright).
+  failed_over — the successor must actually hold the coordinator role
+                at a term >= 1 when the run ends. A run where the
+                election never lands fails here even if no round was
+                lost (the job would be headless on the next join).
+
+A total wall-clock budget bounds the whole check so a hang (a survivor
+deadlocked on the dead coordinator's sync slot) fails fast instead of
+eating the CI job timeout.
+
+Budgets:
+
+  FEDTPU_HA_BUDGET_MS         default 15000 — failover stall ceiling.
+  FEDTPU_HA_MAX_ROUNDS_LOST   default 0.
+  FEDTPU_HA_ROUNDS            default 8 training rounds.
+  FEDTPU_HA_WALL_BUDGET_S     default 300 — cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    budget_ms = float(os.environ.get("FEDTPU_HA_BUDGET_MS", "15000"))
+    max_rounds_lost = int(os.environ.get("FEDTPU_HA_MAX_ROUNDS_LOST", "0"))
+    rounds = int(os.environ.get("FEDTPU_HA_ROUNDS", "8"))
+    wall_budget_s = float(os.environ.get("FEDTPU_HA_WALL_BUDGET_S", "300"))
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            bench._ha_party, "tcp", (rounds,),
+            timeout_s=wall_budget_s, parties=bench._HA3,
+        )
+    elapsed = time.monotonic() - t0
+    if elapsed > wall_budget_s:
+        print(
+            f"HA GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed exceeds "
+            f"the {wall_budget_s:.0f}s budget — a survivor deadlocked on "
+            f"the dead coordinator's sync slot, not just a slow host.",
+            file=sys.stderr,
+        )
+        return 1
+
+    failover_ms = res["coordinator_failover_ms"]
+    lost = res["ha_rounds_lost"]
+    print(
+        f"failover={failover_ms:.0f}ms rounds_lost={lost}/{res['ha_rounds']} "
+        f"failed_over={bool(res['ha_failed_over'])} in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    failed = False
+    if lost > max_rounds_lost:
+        failed = True
+        print(
+            f"HA REGRESSION: {lost} round(s) aggregated ZERO contributors "
+            f"(budget {max_rounds_lost}). Failover must degrade rounds, "
+            f"never lose them: check that the takeover re-broadcast still "
+            f"unblocks the member parked at the orphaned sync point and "
+            f"that elastic aggregation re-plans over the survivors.",
+            file=sys.stderr,
+        )
+    if not res["ha_failed_over"]:
+        failed = True
+        print(
+            "HA REGRESSION: the successor never took the coordinator role "
+            "at a term >= 1 — the job ends headless. Check the liveness "
+            "DEAD -> depose escalation, the deterministic election "
+            "(sorted(roster - deposed)[0]), and the takeover promotion "
+            "path (control handler + DEAD escalation re-registration).",
+            file=sys.stderr,
+        )
+    if failover_ms > budget_ms:
+        failed = True
+        print(
+            f"HA REGRESSION: coordinator_failover_ms {failover_ms:.0f} is "
+            f"over the {budget_ms:.0f}ms budget (FEDTPU_HA_BUDGET_MS) — "
+            f"the takeover should cost one liveness escalation plus one "
+            f"takeover_timeout_s slice, not a sync_timeout_s wait.",
+            file=sys.stderr,
+        )
+    if failed:
+        return 1
+    print(f"ha gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,10 @@ Usage::
 
 One row per (upstream, downstream) seq-id edge, time on the x axis over
 the artifact's full window. Timed spans (send / decode / task / fold /
-publish) render as bars, arrival events (recv) and membership events
-(join / evict / epoch-bump, glyph ``M`` — the epoch boundaries) as
-single ticks, failed spans as ``x``. The point is hang forensics WITHOUT a debugger or a
+publish) render as bars, arrival events (recv), membership events
+(join / evict / epoch-bump, glyph ``M`` — the epoch boundaries) and
+failover events (depose / takeover / handoff, glyph ``V`` — the term
+boundaries) as single ticks, failed spans as ``x``. The point is hang forensics WITHOUT a debugger or a
 Perfetto upload: the recurring gRPC-lane ``_fedavg_party`` wedge — and
 any async-mode straggler — shows up as the edge whose last mark sits far
 left of everyone else's.
@@ -47,6 +48,7 @@ _GLYPHS = {
     "publish": "P",
     "hb": "h",
     "membership": "M",
+    "failover": "V",
     "control": "c",
     "fault": "!",
 }
