@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint chaos latency scale dma shm serve async churn obs privacy ha clean
+.PHONY: native test lint sanitize chaos latency scale dma shm serve async churn obs privacy ha clean
 
 native:
 	python setup.py build_ext --inplace
@@ -8,13 +8,27 @@ native:
 test:
 	./test.sh
 
-# Static checks: license headers, fedlint over the shipped drivers
-# (must be clean), and the fedlint contract tests (fixture corpus +
-# seq-id validation). Mirrors .github/workflows/fedlint.yml.
+# Static checks: license headers, fedlint over the shipped drivers AND
+# the framework itself (both must be clean — every self-lint finding is
+# fixed or suppressed in place with a justification), and the fedlint
+# contract tests (fixture corpus + seq-id validation). Mirrors
+# .github/workflows/fedlint.yml.
 lint:
 	python tools/check_license_headers.py
 	python -m rayfed_tpu.lint examples
+	python -m rayfed_tpu.lint rayfed_tpu
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fedlint.py tests/test_seq_id_validation.py -q
+
+# FedSanitizer lane (docs/sanitizer.md): the probe unit tests (each
+# probe forced to trip), the chaos FedAvg spawn test under
+# FEDTPU_SANITIZE=1 (zero trips, bitwise-identical results vs the
+# unsanitized run), and the overhead gate — sanitized round time must
+# stay within FEDTPU_SANITIZE_BUDGET_PCT (default 10%) of baseline.
+# Mirrors the `sanitize` job in .github/workflows/tests.yml.
+sanitize:
+	JAX_PLATFORMS=cpu FEDTPU_SANITIZE=1 python -m pytest \
+	  tests/test_sanitizer.py -q
+	JAX_PLATFORMS=cpu python tools/sanitize_check.py
 
 # Chaos/failure lane (docs/resilience.md): the seeded fault-schedule
 # FedAvg run plus the multi-process failure-path tests. Slow by design

@@ -147,8 +147,8 @@ class GlobalContext:
             self._atomic_shutdown_flag_lock.release()
 
 
-_global_context: Optional[GlobalContext] = None
-_context_lock = threading.Lock()
+_global_context: Optional[GlobalContext] = None  # fedlint: disable=global-mutable-singleton (job context registry; cleared by clear_global_context at shutdown)
+_context_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (job context registry; cleared by clear_global_context at shutdown)
 
 
 def init_global_context(

@@ -35,8 +35,8 @@ import os
 import threading
 from typing import Dict, Optional
 
-_lock = threading.Lock()
-_initialized_job: Optional[str] = None
+_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
+_initialized_job: Optional[str] = None  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
 
 
 class _MemoryBackend:
@@ -123,7 +123,7 @@ class _FileBackend:
                 pass
 
 
-_backend = _MemoryBackend()
+_backend = _MemoryBackend()  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
 
 
 def kv_configure(backend: str = "memory", path: Optional[str] = None,

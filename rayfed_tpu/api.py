@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import rayfed_tpu._private.constants as constants
 import rayfed_tpu.config as fed_config
 import rayfed_tpu.utils as fed_utils
+from rayfed_tpu import sanitize
 from rayfed_tpu._private import executor
 from rayfed_tpu._private import kv as internal_kv
 from rayfed_tpu._private.call_holder import FedCallHolder
@@ -573,6 +574,10 @@ def _shutdown(intended: bool = True):
     if _collective is not None:
         _collective.clear_joint_collective()
     fed_config.reset_config_cache()
+    # FedSanitizer probe state is per-job: a new job's seq ids start over,
+    # so the monotonicity watermarks (and the other probe maps) must not
+    # carry across or the first send of the next job trips spuriously.
+    sanitize.reset()
     logger.info("Shutdown rayfed_tpu.")
     signal.signal(signal.SIGINT, original_sigint)
     if exit_on_sending_failure:
@@ -957,6 +962,9 @@ def get(
             if on_missing == "drop":
                 gone = set(missing)
                 values = [v for i, v in enumerate(values) if i not in gone]
+        if sanitize.enabled():
+            for value in values:
+                sanitize.probe_donation_alias(value)
         if single:
             # A dropped single object leaves nothing to index: it
             # resolves to the MISSING sentinel instead (the ergonomic

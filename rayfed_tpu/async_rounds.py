@@ -664,8 +664,8 @@ class BufferedAggregator:
 # Process-local session registry (lives at the aggregating party)
 # ---------------------------------------------------------------------------
 
-_sessions: Dict[str, BufferedAggregator] = {}
-_sessions_lock = threading.Lock()
+_sessions: Dict[str, BufferedAggregator] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_sessions_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
 
 
 def _serve_publish_cb(serve_name: str) -> Callable[[int, Any], None]:
@@ -779,9 +779,9 @@ def _async_adopt(name, cfg_dict, serve_name, state):
 # In-flight handoff adoption counter: ``fed.shutdown`` drains it so a
 # job shutting down during an aggregator handoff finishes installing the
 # adopted state before the session registry is cleared.
-_handoff_lock = threading.Lock()
-_handoff_cond = threading.Condition(_handoff_lock)
-_handoffs_inflight = 0
+_handoff_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_handoff_cond = threading.Condition(_handoff_lock)  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_handoffs_inflight = 0  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
 
 
 def _handoff_begin() -> None:
@@ -823,19 +823,19 @@ def _async_stats(name, cfg_dict, serve_name):
 # Job default (config['aggregation']['async_*'] from fed.init), following
 # the topology.set_default pattern: every driver reads the same config,
 # so every driver ships the identical cfg to the root.
-_default_cfg_lock = threading.Lock()
-_default_cfg: Optional[AsyncAggregationConfig] = None
+_default_cfg_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_default_cfg: Optional[AsyncAggregationConfig] = None  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
 
 # Driver-side auto round tags, per session name. Every driver runs the
 # same program, so the counters advance identically on all parties.
-_tags_lock = threading.Lock()
-_driver_round_tags: Dict[str, int] = {}
+_tags_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_driver_round_tags: Dict[str, int] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
 
 # Driver-side memory of the last async_round call per session — the
 # survivor re-offer source for :func:`async_rebuild` when the root died
 # without handing its buffer off. Identical on every driver (same calls,
 # same arguments), so a rebuild lays out the same DAG everywhere.
-_last_rounds: Dict[str, Dict[str, Any]] = {}
+_last_rounds: Dict[str, Dict[str, Any]] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
 
 
 def set_default_async_config(aggregation_dict: Dict[str, Any]) -> None:

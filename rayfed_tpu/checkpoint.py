@@ -93,8 +93,8 @@ class CheckpointConfig:
         return dataclasses.asdict(self)
 
 
-_cfg_lock = threading.Lock()
-_default_cfg: Optional[CheckpointConfig] = None
+_cfg_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (default checkpoint config; reset_default_checkpoint_config() at shutdown)
+_default_cfg: Optional[CheckpointConfig] = None  # fedlint: disable=global-mutable-singleton (default checkpoint config; reset_default_checkpoint_config() at shutdown)
 
 
 def set_default_checkpoint_config(data: Optional[Dict[str, Any]]) -> None:

@@ -38,6 +38,14 @@ executing the same program line — exactly the multi-controller opt-in the
 push lane has.
 """
 
+# fedlint: disable-file=seq-divergence
+# Role-divergent control flow is this plane's contract: the root
+# party reduces while leaves push, so fed.get/send calls are
+# deliberately conditioned on party identity. The wire protocol
+# (one seq id per collective op, burned on every party) keeps the
+# DAG aligned; FED002's same-shape-everywhere rule targets
+# drivers, not this engine.
+
 from __future__ import annotations
 
 import functools
@@ -199,14 +207,14 @@ def cross_party_mean(per_party_trees, mesh: Optional[Mesh] = None,
 import itertools
 import threading as _threading
 
-_joint_lock = _threading.Lock()
-_joint_mesh: Optional[Mesh] = None
-_joint_party_order = None
-_joint_self_party: Optional[str] = None
+_joint_lock = _threading.Lock()  # fedlint: disable=global-mutable-singleton (joint collective state; clear_joint_collective() at shutdown)
+_joint_mesh: Optional[Mesh] = None  # fedlint: disable=global-mutable-singleton (joint collective state; clear_joint_collective() at shutdown)
+_joint_party_order = None  # fedlint: disable=global-mutable-singleton (joint collective state; clear_joint_collective() at shutdown)
+_joint_self_party: Optional[str] = None  # fedlint: disable=global-mutable-singleton (joint collective state; clear_joint_collective() at shutdown)
 # True iff THIS module created the jax.distributed group (the process
 # group outlives fed shutdown; repeat inits may reuse it, foreign groups
 # must not be mistaken for it).
-_joint_group_owned = False
+_joint_group_owned = False  # fedlint: disable=global-mutable-singleton (joint collective state; clear_joint_collective() at shutdown)
 _collective_seq = itertools.count(1)
 
 

@@ -65,8 +65,8 @@ class JobConfig:
 
 
 # Module-level lazy caches (ref fed/config.py:46-75).
-_cluster_config: Optional[ClusterConfig] = None
-_job_config: Optional[JobConfig] = None
+_cluster_config: Optional[ClusterConfig] = None  # fedlint: disable=global-mutable-singleton (config cache; reset_config_cache() at shutdown)
+_job_config: Optional[JobConfig] = None  # fedlint: disable=global-mutable-singleton (config cache; reset_config_cache() at shutdown)
 
 
 def get_cluster_config(job_name: str) -> Optional[ClusterConfig]:

@@ -146,7 +146,7 @@ def _secagg_reduce(op, parties, domain, round_index, weights, *envelopes):
 # the same arguments (the multi-controller contract), so every driver —
 # and therefore every party's masking task — derives the same round
 # index without any extra coordination.
-_secure_round_counters: Dict[str, int] = {}
+_secure_round_counters: Dict[str, int] = {}  # fedlint: disable=global-mutable-singleton (secure-round counters; dropped with the privacy plane at shutdown)
 
 SECURE_SYNC_DOMAIN = "fedagg"
 

@@ -21,12 +21,43 @@ file, syntax error) or usage errors. See ``docs/fedlint.md``.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
-from rayfed_tpu.lint.core import lint_paths
-from rayfed_tpu.lint.reporters import report_json, report_text
+from rayfed_tpu.lint.core import lint_paths, parse_units
+from rayfed_tpu.lint.project import collect_singletons
+from rayfed_tpu.lint.reporters import report_json, report_sarif, report_text
 from rayfed_tpu.lint.rules import ALL_RULES
+
+
+def write_singleton_inventory(paths: List[str], out_path: str) -> int:
+    """Emit the FED008 worklist (every module-level mutable singleton,
+    suppressed sites included) as machine-readable JSON."""
+    _files, units, errors = parse_units(paths)
+    entries = [
+        s.as_dict() for unit in units for s in collect_singletons(unit)
+    ]
+    entries.sort(key=lambda e: (e["module"], e["line"]))
+    payload = {
+        "version": 1,
+        "description": (
+            "Module-level mutable singletons (fedlint FED008): the "
+            "multi-tenant refactor worklist. Suppressed findings still "
+            "appear here; regenerate with `python -m rayfed_tpu.lint "
+            "rayfed_tpu --singleton-inventory "
+            "tools/singleton_inventory.json`."
+        ),
+        "singletons": entries,
+    }
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(
+        f"fedlint: wrote {len(entries)} singleton(s) to {out_path}",
+        file=sys.stderr,
+    )
+    return 2 if errors else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -43,8 +74,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="driver files or directories (directories are walked for .py)",
     )
     parser.add_argument(
-        "-f", "--format", choices=("text", "json"), default="text",
+        "-f", "--format", choices=("text", "json", "sarif"), default="text",
         help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--singleton-inventory", metavar="PATH",
+        help=(
+            "instead of linting, write the FED008 singleton inventory "
+            "(the multi-tenant refactor worklist) as JSON to PATH"
+        ),
     )
     parser.add_argument(
         "--select", action="append", metavar="RULE",
@@ -71,10 +109,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             "(try --list-rules)", file=sys.stderr,
         )
         return 2
+    if args.singleton_inventory:
+        return write_singleton_inventory(args.paths, args.singleton_inventory)
 
     result = lint_paths(args.paths, select=args.select, disable=args.disable)
     if args.format == "json":
         report_json(result, sys.stdout)
+    elif args.format == "sarif":
+        report_sarif(result, sys.stdout)
     else:
         report_text(result, sys.stdout)
     return result.exit_code

@@ -30,6 +30,7 @@ import re
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 from rayfed_tpu.lint.model import DriverModel
+from rayfed_tpu.lint.project import ParsedModule, ProjectModel
 
 #: Directories never descended into when a directory is linted.
 SKIP_DIRS = {
@@ -110,6 +111,40 @@ class Rule:
             )
 
 
+class ProjectRule(Rule):
+    """A rule that sees the whole lint target at once.
+
+    Per-file rules get one ``(tree, model)``; project rules get the
+    :class:`~rayfed_tpu.lint.project.ProjectModel` built over every file
+    in the run, and yield ``(path, node, message)`` so a finding can
+    land in any module the analysis crossed. Single-file entry points
+    (``lint_source``/``lint_file``) still run project rules — over a
+    one-module project — so the fixture corpus exercises them through
+    the same API as everything else.
+    """
+
+    def check_project(
+        self, project: ProjectModel
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        raise NotImplementedError
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        return iter(())
+
+    def project_findings(self, project: ProjectModel) -> Iterator[Finding]:
+        for path, node, message in self.check_project(project):
+            yield Finding(
+                path=path,
+                line=getattr(node, "lineno", 1),
+                col=getattr(node, "col_offset", 0) + 1,
+                rule_id=self.rule_id,
+                rule_name=self.name,
+                message=message,
+            )
+
+
 class _Suppressions:
     """Per-line and per-file ``# fedlint: disable`` directives.
 
@@ -169,30 +204,65 @@ def _resolve_rules(
     return out
 
 
+def _parse_unit(source: str, path: str) -> Tuple[Optional[ParsedModule], Optional[LintError]]:
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, LintError(
+            path=path, line=e.lineno or 1, message=f"syntax error: {e.msg}"
+        )
+    return (
+        ParsedModule(
+            path=path,
+            source=source,
+            tree=tree,
+            model=DriverModel.build(tree),
+            suppressions=_Suppressions(source),
+        ),
+        None,
+    )
+
+
+def _run_rules(
+    units: Sequence[ParsedModule], rules: Sequence[Rule]
+) -> List[Finding]:
+    """Per-file rules on each unit, project rules on the whole set, with
+    every finding filtered through its own file's suppressions."""
+    by_path = {unit.path: unit for unit in units}
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    findings: List[Finding] = []
+    for unit in units:
+        findings.extend(
+            f
+            for rule in file_rules
+            for f in rule.findings(unit.path, unit.tree, unit.model)
+            if not unit.suppressions.suppressed(f)
+        )
+    if project_rules:
+        project = ProjectModel.build(list(units))
+        for rule in project_rules:
+            for f in rule.project_findings(project):
+                owner = by_path.get(f.path)
+                if owner is None or not owner.suppressions.suppressed(f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return findings
+
+
 def lint_source(
     source: str,
     path: str = "<string>",
     rules: Optional[Sequence[Rule]] = None,
 ) -> Tuple[List[Finding], List[LintError]]:
-    """Lint one driver program given as source text."""
+    """Lint one driver program given as source text (project rules run
+    over the single-module project)."""
     if rules is None:
         rules = _resolve_rules()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [], [
-            LintError(path=path, line=e.lineno or 1, message=f"syntax error: {e.msg}")
-        ]
-    model = DriverModel.build(tree)
-    suppress = _Suppressions(source)
-    findings = [
-        f
-        for rule in rules
-        for f in rule.findings(path, tree, model)
-        if not suppress.suppressed(f)
-    ]
-    findings.sort(key=lambda f: (f.line, f.col, f.rule_id))
-    return findings, []
+    unit, error = _parse_unit(source, path)
+    if unit is None:
+        return [], [error]
+    return _run_rules([unit], rules), []
 
 
 def lint_file(
@@ -240,19 +310,40 @@ class LintResult:
         return 1 if self.findings else 0
 
 
+def parse_units(
+    paths: Iterable[str],
+) -> Tuple[List[str], List[ParsedModule], List[LintError]]:
+    """Parse every .py file under ``paths`` into project units (shared by
+    ``lint_paths`` and the CLI's singleton-inventory writer)."""
+    files: List[str] = []
+    units: List[ParsedModule] = []
+    errors: List[LintError] = []
+    for path in iter_python_files(paths):
+        files.append(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            errors.append(LintError(path=path, line=1, message=str(e)))
+            continue
+        unit, error = _parse_unit(source, path)
+        if unit is None:
+            errors.append(error)
+        else:
+            units.append(unit)
+    return files, units, errors
+
+
 def lint_paths(
     paths: Iterable[str],
     select: Optional[Iterable[str]] = None,
     disable: Optional[Iterable[str]] = None,
 ) -> LintResult:
-    """Lint every .py file under ``paths``; the CLI's engine."""
+    """Lint every .py file under ``paths``; the CLI's engine. All files
+    are parsed up front so project rules analyze the whole target at
+    once instead of one file at a time."""
     rules = _resolve_rules(select=select, disable=disable)
-    files: List[str] = []
-    findings: List[Finding] = []
-    errors: List[LintError] = []
-    for path in iter_python_files(paths):
-        files.append(path)
-        got, bad = lint_file(path, rules=rules)
-        findings.extend(got)
-        errors.extend(bad)
-    return LintResult(files=files, findings=findings, errors=errors)
+    files, units, errors = parse_units(paths)
+    return LintResult(
+        files=files, findings=_run_rules(units, rules), errors=errors
+    )

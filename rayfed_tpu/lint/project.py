@@ -1,0 +1,433 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Whole-program analysis layer: the fedlint v2 project model.
+
+PR 1's rules see one file at a time through a :class:`DriverModel`. The
+cross-module rules (FED007 deadlock, FED010 blocking-in-reactor, FED011
+lock-order) need to follow calls across files, so this module parses the
+whole lint target once into a :class:`ProjectModel`:
+
+* every file becomes a :class:`ParsedModule` carrying its tree, its
+  per-file :class:`DriverModel`, a dotted module name recovered from the
+  ``__init__.py`` chain on disk, and a *generic* import map (the
+  DriverModel only resolves ``rayfed_tpu`` imports; project rules must
+  resolve ``from .reactor import _pool`` too);
+* :meth:`ProjectModel.resolve_function` answers "which FunctionDef does
+  this call target", one static hop at a time, conservatively returning
+  ``None`` for anything dynamic.
+
+The singleton inventory consumed by the multi-tenant refactor
+(``tools/singleton_inventory.json``) is also computed here —
+:func:`collect_singletons` is shared by rule FED008 and the CLI's
+``--singleton-inventory`` flag so suppressing a finding never hides the
+site from the worklist.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from rayfed_tpu.lint.model import DriverModel
+
+#: Constructors whose module-level results are immutable-in-practice and
+#: never inventory entries (compiled patterns, loggers, frozen types).
+_IMMUTABLE_CTORS = {
+    "re.compile", "struct.Struct", "logging.getLogger", "frozenset",
+    "tuple", "collections.namedtuple", "namedtuple",
+    "types.MappingProxyType", "MappingProxyType", "os.environ.get",
+}
+
+#: threading constructors that make a module-level synchronization object.
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+}
+
+#: Container constructors that make a module-level mutable value.
+_CONTAINER_CTORS = {
+    "dict", "list", "set", "bytearray",
+    "collections.OrderedDict", "OrderedDict",
+    "collections.defaultdict", "defaultdict",
+    "collections.deque", "deque",
+    "collections.Counter", "Counter",
+    "weakref.WeakSet", "WeakSet",
+    "weakref.WeakValueDictionary", "WeakValueDictionary",
+    "weakref.WeakKeyDictionary", "WeakKeyDictionary",
+}
+
+#: Method calls that mutate their receiver in place.
+_MUTATING_METHODS = {
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "extendleft", "insert", "move_to_end", "pop", "popitem", "remove",
+    "setdefault", "update",
+}
+
+
+@dataclasses.dataclass
+class ParsedModule:
+    """One analyzed source file plus everything rules ask about it."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    model: DriverModel
+    #: the file's ``# fedlint: disable`` table (core._Suppressions; typed
+    #: loosely to keep this module free of a core import cycle).
+    suppressions: object
+    module_name: str = ""
+    #: module-level function defs by name.
+    functions: Dict[str, ast.FunctionDef] = dataclasses.field(
+        default_factory=dict
+    )
+    #: module-level class defs by name.
+    classes: Dict[str, ast.ClassDef] = dataclasses.field(default_factory=dict)
+    #: generic import map: local name -> absolute dotted target. ``import
+    #: a.b`` binds ``a -> a``; ``import a.b as c`` binds ``c -> a.b``;
+    #: ``from a.b import f as g`` binds ``g -> a.b.f`` (relative imports
+    #: resolved against :attr:`module_name`).
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def method(self, cls_name: str, name: str) -> Optional[ast.FunctionDef]:
+        cls = self.classes.get(cls_name)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if stmt.name == name:
+                    return stmt
+        return None
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name recovered by walking ``__init__.py`` parents.
+
+    ``.../rayfed_tpu/proxy/barriers.py`` -> ``rayfed_tpu.proxy.barriers``;
+    files outside any package resolve to their bare stem.
+    """
+    path = os.path.abspath(path)
+    stem = os.path.splitext(os.path.basename(path))[0]
+    parts = [] if stem == "__init__" else [stem]
+    parent = os.path.dirname(path)
+    while os.path.isfile(os.path.join(parent, "__init__.py")):
+        parts.insert(0, os.path.basename(parent))
+        nxt = os.path.dirname(parent)
+        if nxt == parent:
+            break
+        parent = nxt
+    return ".".join(parts) or stem
+
+
+def _resolve_relative(module_name: str, level: int, target: Optional[str]) -> str:
+    """Absolute dotted base for a ``from ...x import y`` statement found
+    inside ``module_name``."""
+    parts = module_name.split(".")
+    # level 1 = the containing package; the module's own last component
+    # is dropped first (for __init__.py modules the name IS the package,
+    # but one spurious-level error only widens to "no resolution").
+    base = parts[: len(parts) - level] if level <= len(parts) else []
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+class ProjectModel:
+    """Every :class:`ParsedModule` in the lint target, cross-indexed."""
+
+    def __init__(self, modules: Sequence[ParsedModule]):
+        self.modules: List[ParsedModule] = list(modules)
+        self.by_path: Dict[str, ParsedModule] = {
+            m.path: m for m in self.modules
+        }
+        self.by_name: Dict[str, ParsedModule] = {
+            m.module_name: m for m in self.modules if m.module_name
+        }
+
+    @classmethod
+    def build(cls, modules: Sequence[ParsedModule]) -> "ProjectModel":
+        for unit in modules:
+            if not unit.module_name:
+                unit.module_name = module_name_for(unit.path)
+            cls._index(unit)
+        return cls(modules)
+
+    @staticmethod
+    def _index(unit: ParsedModule) -> None:
+        for stmt in unit.tree.body:
+            if isinstance(stmt, ast.FunctionDef):
+                unit.functions[stmt.name] = stmt
+            elif isinstance(stmt, ast.ClassDef):
+                unit.classes[stmt.name] = stmt
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        unit.imports[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        unit.imports.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = _resolve_relative(
+                        unit.module_name, node.level, node.module
+                    )
+                elif node.module:
+                    base = node.module
+                else:
+                    continue
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    unit.imports[local] = (
+                        f"{base}.{alias.name}" if base else alias.name
+                    )
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+
+    def resolve_module(self, dotted: str) -> Optional[ParsedModule]:
+        """A project module by absolute dotted name, accepting the
+        package itself for ``pkg/__init__.py``."""
+        return self.by_name.get(dotted)
+
+    def resolve_function(
+        self, unit: ParsedModule, dotted: str
+    ) -> Optional[Tuple[ParsedModule, ast.FunctionDef]]:
+        """The FunctionDef a dotted callable name targets, when it stays
+        inside the project. ``f`` -> local def or from-import;
+        ``mod.f``/``pkg.mod.f`` -> module attribute. ``None`` for
+        anything dynamic, builtin, or outside the lint target."""
+        head, _, rest = dotted.partition(".")
+        if not rest:
+            fn = unit.functions.get(head)
+            if fn is not None:
+                return unit, fn
+            target = unit.imports.get(head)
+            if target is None:
+                return None
+            mod_name, _, sym = target.rpartition(".")
+            other = self.by_name.get(mod_name)
+            if other is not None and sym in other.functions:
+                return other, other.functions[sym]
+            return None
+        # Dotted: the head must name an imported module (possibly itself
+        # dotted, e.g. ``proxy.barriers.send`` after ``import
+        # rayfed_tpu.proxy``); try longest prefix first.
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            target = unit.imports.get(prefix)
+            if target is None:
+                continue
+            mod = self.by_name.get(".".join([target] + parts[cut:-1]))
+            if mod is not None and parts[-1] in mod.functions:
+                return mod, mod.functions[parts[-1]]
+            return None
+        return None
+
+
+# ----------------------------------------------------------------------
+# FED008 singleton inventory
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Singleton:
+    """One module-level mutable object (a multi-tenant refactor worklist
+    entry)."""
+
+    module: str
+    path: str
+    name: str
+    line: int
+    #: ``lock`` | ``container`` | ``cache`` (``global``-rebound name).
+    kind: str
+    value: str
+    #: lines of in-module mutation / rebinding sites.
+    mutators: List[int]
+    node: ast.AST = dataclasses.field(compare=False, repr=False)
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "module": self.module,
+            "path": self.path,
+            "name": self.name,
+            "line": self.line,
+            "kind": self.kind,
+            "value": self.value,
+            "mutators": self.mutators,
+        }
+
+
+def _ctor_name(call: ast.Call, unit: ParsedModule) -> str:
+    from rayfed_tpu.lint.model import dotted_name
+
+    name = dotted_name(call.func) or ""
+    head, _, rest = name.partition(".")
+    target = unit.imports.get(head)
+    if target is not None and target != head:
+        name = f"{target}.{rest}" if rest else target
+    return name
+
+
+def _classify_value(value: ast.expr, unit: ParsedModule) -> Optional[str]:
+    """``lock``/``container`` for values FED008 cares about, else None."""
+    if isinstance(value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                          ast.ListComp, ast.SetComp)):
+        return "container"
+    if isinstance(value, ast.Call):
+        name = _ctor_name(value, unit)
+        if name in _IMMUTABLE_CTORS:
+            return None
+        if name in _LOCK_CTORS:
+            return "lock"
+        if name in _CONTAINER_CTORS:
+            return "container"
+    return None
+
+
+def _module_assigns(tree: ast.Module) -> Iterator[Tuple[str, ast.stmt, ast.expr]]:
+    """(name, stmt, value) for simple module-scope assignments, skipping
+    ``if TYPE_CHECKING:`` blocks and dunders."""
+
+    def from_body(body: List[ast.stmt]) -> Iterator[Tuple[str, ast.stmt, ast.expr]]:
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        yield target.id, stmt, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    yield stmt.target.id, stmt, stmt.value
+            elif isinstance(stmt, (ast.If, ast.Try)):
+                test = getattr(stmt, "test", None)
+                label = test and (
+                    getattr(test, "id", None) or getattr(test, "attr", None)
+                )
+                if label == "TYPE_CHECKING":
+                    continue
+                for field in ("body", "orelse", "finalbody"):
+                    yield from from_body(getattr(stmt, field, []) or [])
+                for handler in getattr(stmt, "handlers", []) or []:
+                    yield from from_body(handler.body)
+
+    for name, stmt, value in from_body(tree.body):
+        if not (name.startswith("__") and name.endswith("__")):
+            yield name, stmt, value
+
+
+def _mutation_lines(tree: ast.Module, name: str) -> List[int]:
+    """Lines where module code mutates or rebinds the module-level
+    ``name`` in place (subscript stores, del, augassign, mutating method
+    calls, and assignments inside functions that declare ``global``)."""
+    lines: List[int] = []
+    global_fns: List[ast.AST] = [
+        node
+        for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and any(
+            isinstance(s, ast.Global) and name in s.names
+            for s in ast.walk(node)
+        )
+    ]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == name
+                ):
+                    lines.append(node.lineno)
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == name
+                ):
+                    lines.append(node.lineno)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATING_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == name
+        ):
+            lines.append(node.lineno)
+    for fn in global_fns:
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    if isinstance(target, ast.Name) and target.id == name:
+                        lines.append(node.lineno)
+    return sorted(set(lines))
+
+
+def collect_singletons(unit: ParsedModule) -> List[Singleton]:
+    """FED008's detector, shared with the CLI inventory writer.
+
+    A module-level name is a singleton when it is (a) a threading
+    synchronization object (always: a lock only exists to serialize
+    shared state), (b) a mutable container the module itself mutates, or
+    (c) a ``global``-rebound cache. Pure constants and aliased imports
+    never match.
+    """
+    if not unit.module_name:
+        unit.module_name = module_name_for(unit.path)
+    globally_rebound = {
+        n
+        for node in ast.walk(unit.tree)
+        if isinstance(node, ast.Global)
+        for n in node.names
+    }
+    out: List[Singleton] = []
+    seen: set = set()
+    for name, stmt, value in _module_assigns(unit.tree):
+        if name in seen:
+            continue
+        kind = _classify_value(value, unit)
+        mutators = _mutation_lines(unit.tree, name)
+        if kind == "container" and not (mutators or name in globally_rebound):
+            continue  # a constant table nobody writes to
+        if kind is None:
+            if name not in globally_rebound:
+                continue
+            kind = "cache"
+        seen.add(name)
+        out.append(
+            Singleton(
+                module=unit.module_name,
+                path=unit.path,
+                name=name,
+                line=stmt.lineno,
+                kind=kind,
+                value=ast.unparse(value)[:80],
+                mutators=mutators,
+                node=stmt,
+            )
+        )
+    out.sort(key=lambda s: s.line)
+    return out

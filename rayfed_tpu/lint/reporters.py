@@ -12,7 +12,7 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""fedlint output formats: human text and machine JSON.
+"""fedlint output formats: human text, machine JSON, and SARIF 2.1.0.
 
 The JSON shape is stable (CI consumes it):
 
@@ -21,6 +21,10 @@ The JSON shape is stable (CI consumes it):
      "findings": [{"path", "line", "col", "rule_id", "rule_name",
                    "message"}, ...],
      "errors": [{"path", "line", "message"}, ...]}
+
+SARIF (``--format sarif``) targets the GitHub code-scanning upload
+schema so the CI lint job annotates PR diffs in place instead of
+dumping text into the job log.
 """
 
 from __future__ import annotations
@@ -57,6 +61,79 @@ def report_json(result: LintResult, out: IO[str]) -> None:
         "files": len(result.files),
         "findings": [f.as_dict() for f in result.findings],
         "errors": [e.as_dict() for e in result.errors],
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")
+
+
+def report_sarif(result: LintResult, out: IO[str]) -> None:
+    """SARIF 2.1.0 for GitHub code scanning (PR-diff annotations)."""
+    from rayfed_tpu.lint.rules import ALL_RULES
+
+    results = [
+        {
+            "ruleId": f.rule_id,
+            "level": "warning",
+            "message": {"text": f"[{f.rule_name}] {f.message}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path.replace("\\", "/"),
+                        },
+                        "region": {
+                            "startLine": f.line,
+                            "startColumn": f.col,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in result.findings
+    ]
+    for e in result.errors:
+        results.append(
+            {
+                "ruleId": "fedlint-error",
+                "level": "error",
+                "message": {"text": e.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": e.path.replace("\\", "/"),
+                            },
+                            "region": {"startLine": e.line},
+                        }
+                    }
+                ],
+            }
+        )
+    payload = {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+            "Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "fedlint",
+                        "informationUri": "docs/fedlint.md",
+                        "rules": [
+                            {
+                                "id": rule.rule_id,
+                                "name": rule.name,
+                                "shortDescription": {"text": rule.summary},
+                            }
+                            for rule in ALL_RULES
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
     }
     json.dump(payload, out, indent=2, sort_keys=True)
     out.write("\n")

@@ -18,12 +18,17 @@ tuple. Rule ids are stable (suppressions and anchors reference them)."""
 from typing import Optional, Tuple
 
 from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.rules.blocking import BlockingCallInReactorRule
+from rayfed_tpu.lint.rules.config_keys import UnvalidatedConfigKeyRule
 from rayfed_tpu.lint.rules.dangling import DanglingFedObjectRule
+from rayfed_tpu.lint.rules.deadlock import CrossPartyDeadlockRule
 from rayfed_tpu.lint.rules.divergence import SeqDivergenceRule
 from rayfed_tpu.lint.rules.donation import DonationAliasingRule
+from rayfed_tpu.lint.rules.lock_order import LockOrderInconsistencyRule
 from rayfed_tpu.lint.rules.perimeter import PerimeterRule
 from rayfed_tpu.lint.rules.privacy import InsecureAggregateRule
 from rayfed_tpu.lint.rules.reserved_seq import ReservedSeqIdRule
+from rayfed_tpu.lint.rules.singleton import GlobalMutableSingletonRule
 
 ALL_RULES: Tuple[Rule, ...] = (
     PerimeterRule(),
@@ -32,6 +37,11 @@ ALL_RULES: Tuple[Rule, ...] = (
     DanglingFedObjectRule(),
     ReservedSeqIdRule(),
     InsecureAggregateRule(),
+    CrossPartyDeadlockRule(),
+    GlobalMutableSingletonRule(),
+    UnvalidatedConfigKeyRule(),
+    BlockingCallInReactorRule(),
+    LockOrderInconsistencyRule(),
 )
 
 

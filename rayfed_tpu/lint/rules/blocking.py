@@ -1,0 +1,298 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED010 ``blocking-call-in-reactor``: no blocking on the loop thread.
+
+One reactor thread services EVERY connection in its pool
+(``proxy/tcp/reactor.py``): a ``time.sleep``, an untimed ``.result()``/
+``.join()``, a blocking connect, or a ``fed.get`` anywhere on a path the
+loop thread executes stalls all lanes at once — the exact pathology the
+reactor exists to avoid. Reachability roots are (a) callbacks handed to
+``run_soon``/``add_ticker``, (b) the handler-protocol methods
+(``on_readable``/``on_flushed``/``on_error``/``on_acceptable``/
+``pending_chunks``) of any class that also defines ``fileno``, and (c)
+the caller-thread inline-send fast path (``_try_inline_send``), which
+holds the submit gate other threads spin on. The walk follows static
+calls across project modules (depth-limited); callables merely
+*deferred* via a nested ``run_soon`` are not followed — re-deferral is
+the correct idiom. Bounded, justified waits suppress per-site with
+``# fedlint: disable=blocking-call-in-reactor``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from rayfed_tpu.lint.core import ProjectRule
+from rayfed_tpu.lint.model import FED_GET, dotted_name
+from rayfed_tpu.lint.project import ParsedModule, ProjectModel
+
+_DEFER_METHODS = {"run_soon", "add_ticker"}
+_HANDLER_METHODS = {
+    "on_readable", "on_flushed", "on_error", "on_acceptable",
+    "pending_chunks",
+}
+_INLINE_SEND = "_try_inline_send"
+_MAX_DEPTH = 8
+
+
+def _resolved_dotted(call: ast.Call, unit: ParsedModule) -> str:
+    """Dotted callee name with the leading alias resolved through the
+    module's import map (``import time as t; t.sleep`` -> time.sleep)."""
+    name = dotted_name(call.func) or ""
+    head, _, rest = name.partition(".")
+    target = unit.imports.get(head)
+    if target is not None and target != head:
+        return f"{target}.{rest}" if rest else target
+    return name
+
+
+def _blocking_reason(call: ast.Call, unit: ParsedModule) -> Optional[str]:
+    if unit.model.canonical_call(call) == FED_GET:
+        return "fed.get (blocks until the peer's bytes arrive)"
+    name = _resolved_dotted(call, unit)
+    if name == "time.sleep":
+        return "time.sleep"
+    if name == "socket.create_connection":
+        return "socket.create_connection (blocking connect)"
+    if (
+        isinstance(call.func, ast.Attribute)
+        and not call.args
+        and not call.keywords
+    ):
+        if call.func.attr == "result":
+            return ".result() with no timeout"
+        if call.func.attr == "join":
+            return ".join() with no timeout"
+    return None
+
+
+def _is_deferral(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _DEFER_METHODS
+    ) or (
+        isinstance(call.func, ast.Name) and call.func.id in _DEFER_METHODS
+    )
+
+
+def _live_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Calls executed when ``fn`` runs: nested def/class/lambda bodies
+    are their own call-time, and args of ``run_soon``/``add_ticker`` are
+    deferred back onto the queue, so neither is descended into."""
+
+    def visit(node: ast.AST) -> Iterator[ast.Call]:
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            return
+        if isinstance(node, ast.Call):
+            yield node
+            if _is_deferral(node):
+                # visit the receiver chain, not the deferred callback.
+                yield from visit(node.func.value) if isinstance(
+                    node.func, ast.Attribute
+                ) else iter(())
+                return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+
+    body = getattr(fn, "body", [])
+    for node in body if isinstance(body, list) else [body]:
+        yield from visit(node)
+
+
+class _Root:
+    def __init__(self, unit: ParsedModule, fn: ast.AST, cls: Optional[str],
+                 label: str):
+        self.unit = unit
+        self.fn = fn
+        self.cls = cls
+        self.label = label
+
+
+class BlockingCallInReactorRule(ProjectRule):
+    rule_id = "FED010"
+    name = "blocking-call-in-reactor"
+    summary = (
+        "blocking call reachable from a reactor callback or the lane "
+        "inline-send path stalls every connection on the loop thread"
+    )
+
+    def check_project(
+        self, project: ProjectModel
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        reported: Set[Tuple[str, int, int]] = set()
+        for root in self._roots(project):
+            yield from self._walk(project, root, reported)
+
+    # ------------------------------------------------------------------
+    # roots
+    # ------------------------------------------------------------------
+
+    def _roots(self, project: ProjectModel) -> Iterator[_Root]:
+        for unit in project.modules:
+            # (a) run_soon / add_ticker callback arguments.
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Call) and _is_deferral(node):
+                    attr = (
+                        node.func.attr
+                        if isinstance(node.func, ast.Attribute)
+                        else node.func.id
+                    )
+                    if node.args:
+                        yield from self._callback_root(
+                            project, unit, node, node.args[0], attr
+                        )
+            # (b) handler-protocol methods on fileno-bearing classes, and
+            # (c) inline-send fast paths.
+            for cls_name, cls in unit.classes.items():
+                methods = {
+                    s.name: s
+                    for s in cls.body
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                is_handler = "fileno" in methods
+                for name, fn in methods.items():
+                    if is_handler and name in _HANDLER_METHODS:
+                        yield _Root(
+                            unit, fn, cls_name,
+                            f"reactor handler {cls_name}.{name}",
+                        )
+                    elif name == _INLINE_SEND:
+                        yield _Root(
+                            unit, fn, cls_name,
+                            f"lane inline-send path {cls_name}.{name}",
+                        )
+            for name, fn in unit.functions.items():
+                if name == _INLINE_SEND:
+                    yield _Root(unit, fn, None, f"lane inline-send path {name}")
+
+    def _callback_root(
+        self,
+        project: ProjectModel,
+        unit: ParsedModule,
+        call: ast.Call,
+        arg: ast.expr,
+        via: str,
+    ) -> Iterator[_Root]:
+        label_prefix = f"{via} callback"
+        if isinstance(arg, ast.Lambda):
+            yield _Root(unit, arg, self._enclosing_class(unit, call),
+                        f"{label_prefix} <lambda>")
+            return
+        if (
+            isinstance(arg, ast.Call)
+            and _resolved_dotted(arg, unit) in ("functools.partial", "partial")
+            and arg.args
+        ):
+            arg = arg.args[0]
+        if isinstance(arg, ast.Name):
+            resolved = project.resolve_function(unit, arg.id)
+            if resolved is not None:
+                yield _Root(resolved[0], resolved[1], None,
+                            f"{label_prefix} {arg.id}")
+        elif (
+            isinstance(arg, ast.Attribute)
+            and isinstance(arg.value, ast.Name)
+            and arg.value.id == "self"
+        ):
+            cls = self._enclosing_class(unit, call)
+            if cls is not None:
+                fn = unit.method(cls, arg.attr)
+                if fn is not None:
+                    yield _Root(unit, fn, cls,
+                                f"{label_prefix} {cls}.{arg.attr}")
+
+    @staticmethod
+    def _enclosing_class(unit: ParsedModule, node: ast.AST) -> Optional[str]:
+        for cls_name, cls in unit.classes.items():
+            for sub in ast.walk(cls):
+                if sub is node:
+                    return cls_name
+        return None
+
+    # ------------------------------------------------------------------
+    # reachability
+    # ------------------------------------------------------------------
+
+    def _walk(
+        self,
+        project: ProjectModel,
+        root: _Root,
+        reported: Set[Tuple[str, int, int]],
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        stack: List[Tuple[ParsedModule, ast.AST, Optional[str], Tuple[str, ...]]] = [
+            (root.unit, root.fn, root.cls, ())
+        ]
+        visited: Set[Tuple[str, int]] = set()
+        while stack:
+            unit, fn, cls, chain = stack.pop()
+            key = (unit.path, id(fn))
+            if key in visited or len(chain) > _MAX_DEPTH:
+                continue
+            visited.add(key)
+            for call in _live_calls(fn):
+                reason = _blocking_reason(call, unit)
+                if reason is not None:
+                    site = (unit.path, call.lineno, call.col_offset)
+                    if site in reported:
+                        continue
+                    reported.add(site)
+                    via = (
+                        f" via {' -> '.join(chain)}" if chain else ""
+                    )
+                    yield (
+                        unit.path,
+                        call,
+                        f"blocking call ({reason}) reachable from "
+                        f"{root.label}{via}: the loop thread services "
+                        f"every connection — blocking here stalls all "
+                        f"lanes; defer with run_soon or bound the wait",
+                    )
+                    continue
+                if _is_deferral(call):
+                    continue
+                for nxt in self._call_targets(project, unit, cls, call):
+                    nxt_unit, nxt_fn, nxt_cls, name = nxt
+                    stack.append((nxt_unit, nxt_fn, nxt_cls, chain + (name,)))
+
+    def _call_targets(
+        self,
+        project: ProjectModel,
+        unit: ParsedModule,
+        cls: Optional[str],
+        call: ast.Call,
+    ) -> Iterator[Tuple[ParsedModule, ast.AST, Optional[str], str]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            resolved = project.resolve_function(unit, func.id)
+            if resolved is not None:
+                yield resolved[0], resolved[1], None, func.id
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        if isinstance(func.value, ast.Name) and func.value.id == "self":
+            if cls is not None:
+                fn = unit.method(cls, func.attr)
+                if fn is not None:
+                    yield unit, fn, cls, f"self.{func.attr}"
+            return
+        dotted = dotted_name(func)
+        if dotted is not None:
+            resolved = project.resolve_function(unit, dotted)
+            if resolved is not None:
+                yield resolved[0], resolved[1], None, dotted

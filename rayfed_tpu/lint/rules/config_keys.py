@@ -1,0 +1,238 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED009 ``unvalidated-config-key``: typo'd config keys that runtime
+validation would silently drop.
+
+``*Config.from_dict`` keeps reference parity by silently DROPPING
+unknown keys (config.py): ``{"timeout_in_msx": 1}`` never errors, the
+knob never takes effect, and the job runs with the default. The rule
+checks every string key in literal dicts flowing into
+``fed.init(config=...)`` (top-level keys, section dicts, and the nested
+retry/liveness/failover schemas) and into ``<Class>.from_dict({...})``
+against the static schema mirror in ``rayfed_tpu/lint/schema.py``
+(pinned against the real dataclasses by a runtime test). String
+subscripts on dicts that were passed as a config are checked too.
+Messages carry a did-you-mean suggestion.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+from typing import Dict, Iterable, Iterator, Optional, Tuple
+
+from rayfed_tpu.lint import schema
+from rayfed_tpu.lint.core import Rule
+from rayfed_tpu.lint.model import FED_INIT, DriverModel
+
+
+def _suggest(key: str, known: Iterable[str]) -> str:
+    close = difflib.get_close_matches(key, list(known), n=1, cutoff=0.6)
+    return f" (did you mean {close[0]!r}?)" if close else ""
+
+
+def _literal_str_keys(d: ast.Dict) -> Iterator[Tuple[str, ast.AST, ast.expr]]:
+    for key, value in zip(d.keys, d.values):
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            yield key.value, key, value
+
+
+class UnvalidatedConfigKeyRule(Rule):
+    rule_id = "FED009"
+    name = "unvalidated-config-key"
+    summary = (
+        "config key not in the *Config.from_dict schema: from_dict "
+        "silently drops it, so the knob never takes effect"
+    )
+
+    def check(
+        self, tree: ast.Module, model: DriverModel
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        dict_bindings = self._dict_bindings(tree)
+        config_names = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if model.canonical_call(node) == FED_INIT:
+                for expr in self._config_args(node):
+                    if isinstance(expr, ast.Name):
+                        config_names.add(expr.id)
+                    d = self._as_dict(expr, dict_bindings)
+                    if d is not None:
+                        yield from self._check_top_level(d, dict_bindings)
+            else:
+                yield from self._check_from_dict(node, model, dict_bindings)
+        yield from self._check_subscripts(tree, config_names, dict_bindings)
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _config_args(call: ast.Call) -> Iterator[ast.expr]:
+        for kw in call.keywords:
+            if kw.arg == "config":
+                yield kw.value
+        for arg in call.args:
+            if isinstance(arg, ast.Dict):
+                yield arg
+
+    @staticmethod
+    def _dict_bindings(tree: ast.Module) -> Dict[str, Optional[ast.Dict]]:
+        """Name -> literal dict when the name is bound exactly once in
+        the file (rebinding makes it ambiguous -> None)."""
+        out: Dict[str, Optional[ast.Dict]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                targets, value = [node.target.id], node.value
+            else:
+                continue
+            for name in targets:
+                if name in out:
+                    out[name] = None
+                elif isinstance(value, ast.Dict):
+                    out[name] = value
+        return out
+
+    @staticmethod
+    def _as_dict(
+        expr: Optional[ast.expr], bindings: Dict[str, Optional[ast.Dict]]
+    ) -> Optional[ast.Dict]:
+        if isinstance(expr, ast.Dict):
+            return expr
+        if isinstance(expr, ast.Name):
+            return bindings.get(expr.id)
+        return None
+
+    # ------------------------------------------------------------------
+
+    def _check_top_level(
+        self, d: ast.Dict, bindings: Dict[str, Optional[ast.Dict]]
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for key, key_node, value in _literal_str_keys(d):
+            if key not in schema.TOP_LEVEL_KEYS:
+                yield (
+                    key_node,
+                    f"unknown top-level config key {key!r}"
+                    f"{_suggest(key, schema.TOP_LEVEL_KEYS)} — fed.init "
+                    f"ignores it silently",
+                )
+                continue
+            section_keys = schema.section_schema(key)
+            section_dict = self._as_dict(value, bindings)
+            if section_keys is None or section_dict is None:
+                continue
+            yield from self._check_section(key, section_dict, section_keys, bindings)
+
+    def _check_section(
+        self,
+        section: str,
+        d: ast.Dict,
+        allowed,
+        bindings: Dict[str, Optional[ast.Dict]],
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        for key, key_node, value in _literal_str_keys(d):
+            if key not in allowed:
+                yield (
+                    key_node,
+                    f"unknown key {key!r} in config section {section!r}"
+                    f"{_suggest(key, allowed)} — from_dict drops unknown "
+                    f"keys silently, so this knob never takes effect",
+                )
+                continue
+            if (section, key) in schema.OPAQUE_SECTION_VALUES:
+                continue
+            nested = schema.nested_schema(section, key)
+            nested_dict = self._as_dict(value, bindings)
+            if nested is not None and nested_dict is not None:
+                yield from self._check_section(
+                    f"{section}.{key}", nested_dict, nested, bindings
+                )
+
+    def _check_from_dict(
+        self,
+        call: ast.Call,
+        model: DriverModel,
+        bindings: Dict[str, Optional[ast.Dict]],
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "from_dict"):
+            return
+        cls_name: Optional[str] = None
+        path = model.resolved_path(func.value)
+        if path:
+            cls_name = path[-1]
+        elif isinstance(func.value, ast.Name):
+            cls_name = func.value.id
+        fields = schema.CONFIG_CLASS_FIELDS.get(cls_name or "")
+        if fields is None or not call.args:
+            return
+        d = self._as_dict(call.args[0], bindings)
+        if d is None:
+            return
+        for key, key_node, _value in _literal_str_keys(d):
+            if key not in fields:
+                yield (
+                    key_node,
+                    f"unknown key {key!r} for {cls_name}.from_dict"
+                    f"{_suggest(key, fields)} — dropped silently at "
+                    f"runtime",
+                )
+
+    def _check_subscripts(
+        self,
+        tree: ast.Module,
+        config_names: set,
+        bindings: Dict[str, Optional[ast.Dict]],
+    ) -> Iterator[Tuple[ast.AST, str]]:
+        """String indexing on a dict that was passed as a fed.init
+        config must use schema keys."""
+        for node in ast.walk(tree):
+            key_node: Optional[ast.AST] = None
+            name: Optional[str] = None
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)
+            ):
+                name, key_node, key = node.value.id, node.slice, node.slice.value
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and isinstance(node.func.value, ast.Name)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                name, key_node, key = (
+                    node.func.value.id, node.args[0], node.args[0].value,
+                )
+            else:
+                continue
+            if name not in config_names:
+                continue
+            if key not in schema.TOP_LEVEL_KEYS:
+                yield (
+                    key_node,
+                    f"config[{key!r}] is not a known top-level config key"
+                    f"{_suggest(key, schema.TOP_LEVEL_KEYS)}",
+                )

@@ -1,0 +1,225 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED007 ``cross-party-deadlock``: mutual fed.get wait cycles between
+``.party()``-pinned tasks.
+
+A remote task whose body calls ``fed.get`` on one of its parameters
+BLOCKS its party's worker until the peer's bytes arrive — unlike the
+implicit owner-push of a plain FedObject argument, the pull holds the
+executing thread. When two such pulling tasks are pinned to different
+parties and each one's argument is the other's result variable (the
+loop-carried ``a = f.party("alice").remote(b); b =
+f.party("bob").remote(a)`` exchange), the parties' blocking pulls form a
+wait cycle: each party's round-k pull gates the send the peer's round-k
+pull is waiting on, so any divergence — a retry, a dropped connection,
+reordered delivery — wedges both parties with no error. The rule walks
+the whole project (the task def and its invocations may live in
+different modules), builds the variable-level wait graph over
+``.party("<literal>")``-pinned invocations of pulling tasks, and flags
+every invocation on a cycle. Deliberately pipelined ping-pong exchanges
+that tolerate the coupling can suppress with
+``# fedlint: disable=cross-party-deadlock`` after review.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from rayfed_tpu.lint.core import ProjectRule
+from rayfed_tpu.lint.model import FED_GET, iter_scopes
+from rayfed_tpu.lint.project import ParsedModule, ProjectModel
+
+
+def _pulling_params(fn: ast.AST, unit: ParsedModule) -> Set[str]:
+    """Parameter names the remote function body ``fed.get``s."""
+    params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+    pulled: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if unit.model.canonical_call(node) != FED_GET:
+            continue
+        for arg in node.args:
+            elements = (
+                list(arg.elts)
+                if isinstance(arg, (ast.List, ast.Tuple))
+                else [arg]
+            )
+            for element in elements:
+                if isinstance(element, ast.Name) and element.id in params:
+                    pulled.add(element.id)
+    return pulled
+
+
+def _pulling_remote_functions(
+    project: ProjectModel,
+) -> Dict[Tuple[str, str], Set[str]]:
+    """(module, task name) -> pulled parameter names, for every
+    ``@fed.remote`` function in the project whose body pulls a param."""
+    out: Dict[Tuple[str, str], Set[str]] = {}
+    for unit in project.modules:
+        for name in unit.model.remote_functions:
+            fn = unit.functions.get(name)
+            if fn is None:
+                continue
+            pulled = _pulling_params(fn, unit)
+            if pulled:
+                out[(unit.module_name, name)] = pulled
+    return out
+
+
+@dataclasses.dataclass
+class _Binding:
+    """Last ``var = task.party("<p>").remote(...)`` seen in a scope."""
+
+    var: str
+    party: str
+    call: ast.Call
+    #: resolved key into the pulling-task table, when the base resolves.
+    task: Optional[Tuple[str, str]]
+    #: names of the args passed in pulled parameter positions.
+    pulled_args: List[str]
+
+
+class CrossPartyDeadlockRule(ProjectRule):
+    rule_id = "FED007"
+    name = "cross-party-deadlock"
+    summary = (
+        "mutual fed.get wait cycle between .party()-pinned tasks whose "
+        "bodies pull their arguments"
+    )
+
+    def check_project(
+        self, project: ProjectModel
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        pulling = _pulling_remote_functions(project)
+        if not pulling:
+            return
+        for unit in project.modules:
+            for scope in iter_scopes(unit.tree):
+                yield from self._check_scope(
+                    unit, scope.statements, pulling, project
+                )
+
+    # ------------------------------------------------------------------
+
+    def _resolve_task(
+        self, unit: ParsedModule, base_name: str, project: ProjectModel
+    ) -> Optional[Tuple[str, str]]:
+        """Map an invocation base name onto a (module, task) key."""
+        if base_name in unit.model.remote_functions:
+            return (unit.module_name, base_name)
+        resolved = project.resolve_function(unit, base_name)
+        if resolved is not None:
+            other, fn = resolved
+            if fn.name in other.model.remote_functions:
+                return (other.module_name, fn.name)
+        return None
+
+    def _check_scope(
+        self,
+        unit: ParsedModule,
+        statements: List[ast.stmt],
+        pulling: Dict[Tuple[str, str], Set[str]],
+        project: ProjectModel,
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        bindings: Dict[str, _Binding] = {}
+        for stmt in statements:
+            if not isinstance(stmt, ast.Assign) or not isinstance(
+                stmt.value, ast.Call
+            ):
+                continue
+            inv = unit.model.remote_invocation(stmt.value)
+            if inv is None or inv.pinned_party is None or inv.base_name is None:
+                continue
+            task = self._resolve_task(unit, inv.base_name, project)
+            if task is None or task not in pulling:
+                continue
+            fn_unit = project.by_name.get(task[0], unit)
+            fn = fn_unit.functions.get(task[1])
+            if fn is None:
+                continue
+            positional = [a.arg for a in fn.args.args]
+            pulled_params = pulling[task]
+            pulled_args: List[str] = []
+            for idx, arg in enumerate(stmt.value.args):
+                if not isinstance(arg, ast.Name):
+                    continue
+                if idx < len(positional) and positional[idx] in pulled_params:
+                    pulled_args.append(arg.id)
+            for kw in stmt.value.keywords:
+                if kw.arg in pulled_params and isinstance(kw.value, ast.Name):
+                    pulled_args.append(kw.value.id)
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    bindings[target.id] = _Binding(
+                        var=target.id,
+                        party=inv.pinned_party,
+                        call=stmt.value,
+                        task=task,
+                        pulled_args=pulled_args,
+                    )
+        yield from self._report_cycles(unit, bindings)
+
+    def _report_cycles(
+        self, unit: ParsedModule, bindings: Dict[str, _Binding]
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        # Wait edge var -> arg: the task bound to `var` blocks in a
+        # fed.get on `arg`'s bytes, and the two run on different parties.
+        edges: Dict[str, Set[str]] = {}
+        for b in bindings.values():
+            for arg in b.pulled_args:
+                peer = bindings.get(arg)
+                if peer is not None and peer.party != b.party:
+                    edges.setdefault(b.var, set()).add(arg)
+        on_cycle: Set[str] = set()
+        for start in edges:
+            if start in on_cycle:
+                continue
+            # DFS looking for a path back to `start`.
+            stack, seen = [(start, iter(edges.get(start, ())))], {start}
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt == start:
+                        on_cycle.update(n for n, _ in stack)
+                        continue
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append((nxt, iter(edges.get(nxt, ()))))
+                        advanced = True
+                        break
+                if not advanced:
+                    stack.pop()
+        for var in sorted(on_cycle, key=lambda v: bindings[v].call.lineno):
+            b = bindings[var]
+            peers = ", ".join(
+                f"{a!r} (party {bindings[a].party!r})"
+                for a in sorted(b.pulled_args)
+                if a in on_cycle
+            )
+            yield (
+                unit.path,
+                b.call,
+                f"task bound to {var!r} on party {b.party!r} blocks in "
+                f"fed.get on {peers}, which in turn waits on {var!r}: a "
+                f"cross-party wait cycle — any retry or reordering wedges "
+                f"both parties with no error; break the cycle by passing "
+                f"FedObjects without an in-task fed.get (owner push) or "
+                f"staggering the exchange",
+            )

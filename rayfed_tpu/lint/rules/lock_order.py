@@ -1,0 +1,248 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED011 ``lock-order-inconsistency``: two locks taken in both orders.
+
+The proxy planes are lock-heavy (submit gates, pool locks, hook locks)
+and multi-threaded (caller threads, reactor loops, dial threads): two
+locks acquired in opposite orders on two static paths is the classic
+ABBA deadlock, needing only unlucky scheduling to fire. The rule
+identifies locks structurally — module-level ``threading.Lock/RLock/
+Condition`` assignments and ``self.X = threading.Lock()`` instance
+attributes (plus any ``with``-acquired name ending in ``lock``/
+``mutex``) — keyed as ``module.Class.attr`` so the same attribute on
+two *instances* of one class is one lock identity (self-pairs are
+skipped: instance-crossing orders need runtime identity the linter
+cannot see). Acquisition order is read from ``with`` nesting plus one
+static call hop (a function called under lock A that itself takes lock
+B contributes the pair A<B). Both orders for a pair => a finding at the
+first site of each direction, each naming the other.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from rayfed_tpu.lint.core import ProjectRule
+from rayfed_tpu.lint.model import dotted_name
+from rayfed_tpu.lint.project import ParsedModule, ProjectModel
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_LOCK_SUFFIXES = ("lock", "mutex")
+
+
+def _is_lock_ctor(value: ast.expr, unit: ParsedModule) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func) or ""
+    head, _, rest = name.partition(".")
+    target = unit.imports.get(head)
+    if target is not None and target != head:
+        name = f"{target}.{rest}" if rest else target
+    return name in _LOCK_CTORS
+
+
+class _UnitLocks:
+    """Structurally known lock names for one module."""
+
+    def __init__(self, unit: ParsedModule):
+        self.unit = unit
+        self.module_locks: Set[str] = set()
+        self.attr_locks: Dict[str, Set[str]] = {}  # class -> attr names
+        for stmt in unit.tree.body:
+            if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value, unit):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+        for cls_name, cls in unit.classes.items():
+            attrs: Set[str] = set()
+            for node in ast.walk(cls):
+                if isinstance(node, ast.Assign) and _is_lock_ctor(
+                    node.value, unit
+                ):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            attrs.add(t.attr)
+            if attrs:
+                self.attr_locks[cls_name] = attrs
+
+    def key(self, expr: ast.expr, cls: Optional[str]) -> Optional[str]:
+        """Stable identity for a ``with <expr>:`` acquisition, or None
+        when the expression is not recognizably a lock."""
+        mod = self.unit.module_name
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks or expr.id.lower().endswith(
+                _LOCK_SUFFIXES
+            ):
+                return f"{mod}.{expr.id}"
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and cls is not None
+        ):
+            known = self.attr_locks.get(cls, set())
+            if expr.attr in known or expr.attr.lower().endswith(
+                _LOCK_SUFFIXES
+            ):
+                return f"{mod}.{cls}.{expr.attr}"
+        return None
+
+
+class LockOrderInconsistencyRule(ProjectRule):
+    rule_id = "FED011"
+    name = "lock-order-inconsistency"
+    summary = (
+        "two locks acquired in both orders on different static paths "
+        "(ABBA deadlock)"
+    )
+
+    def check_project(
+        self, project: ProjectModel
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        unit_locks = {u.path: _UnitLocks(u) for u in project.modules}
+        #: ordered pair (outer, inner) -> first acquisition site.
+        pairs: Dict[Tuple[str, str], Tuple[str, ast.AST]] = {}
+        for unit in project.modules:
+            locks = unit_locks[unit.path]
+            for cls, fn in self._functions(unit):
+                self._collect(
+                    project, unit, locks, unit_locks, cls, fn, pairs
+                )
+        # Both directions of an inconsistent pair report, one finding at
+        # each direction's first site, each naming the other.
+        for (a, b), (path, node) in sorted(
+            pairs.items(), key=lambda kv: (kv[1][0], kv[1][1].lineno)
+        ):
+            other = pairs.get((b, a))
+            if other is None:
+                continue
+            yield (
+                path,
+                node,
+                f"lock {b!r} is acquired while holding {a!r} here, but "
+                f"the opposite order occurs at {other[0]}:"
+                f"{getattr(other[1], 'lineno', 1)} — inconsistent lock "
+                f"order deadlocks under concurrent execution; pick one "
+                f"global order",
+            )
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _functions(
+        unit: ParsedModule,
+    ) -> Iterator[Tuple[Optional[str], ast.AST]]:
+        for fn in unit.functions.values():
+            yield None, fn
+        for cls_name, cls in unit.classes.items():
+            for stmt in cls.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield cls_name, stmt
+
+    def _collect(
+        self,
+        project: ProjectModel,
+        unit: ParsedModule,
+        locks: _UnitLocks,
+        unit_locks: Dict[str, _UnitLocks],
+        cls: Optional[str],
+        fn: ast.AST,
+        pairs: Dict[Tuple[str, str], Tuple[str, ast.AST]],
+    ) -> None:
+        def record(outer: str, inner: str, node: ast.AST) -> None:
+            if outer == inner:
+                return  # same identity (often two instances); undecidable
+            pairs.setdefault((outer, inner), (unit.path, node))
+
+        def callee_locks(
+            target_unit: ParsedModule,
+            target_cls: Optional[str],
+            target_fn: ast.AST,
+        ) -> List[str]:
+            tlocks = unit_locks[target_unit.path]
+            out: List[str] = []
+            for node in ast.walk(target_fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        k = tlocks.key(item.context_expr, target_cls)
+                        if k is not None:
+                            out.append(k)
+            return out
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new = held
+                for item in node.items:
+                    k = locks.key(item.context_expr, cls)
+                    if k is None:
+                        continue
+                    for h in new:
+                        record(h, k, item.context_expr)
+                    new = new + (k,)
+                for stmt in node.body:
+                    visit(stmt, new)
+                return
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                return
+            if held and isinstance(node, ast.Call):
+                for t_unit, t_fn, t_cls in self._call_targets(
+                    project, unit, cls, node
+                ):
+                    for k in callee_locks(t_unit, t_cls, t_fn):
+                        for h in held:
+                            record(h, k, node)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(fn, "body", []):
+            visit(stmt, ())
+
+    @staticmethod
+    def _call_targets(
+        project: ProjectModel,
+        unit: ParsedModule,
+        cls: Optional[str],
+        call: ast.Call,
+    ) -> Iterator[Tuple[ParsedModule, ast.AST, Optional[str]]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            resolved = project.resolve_function(unit, func.id)
+            if resolved is not None:
+                yield resolved[0], resolved[1], None
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        if isinstance(func.value, ast.Name) and func.value.id == "self":
+            if cls is not None:
+                fn = unit.method(cls, func.attr)
+                if fn is not None:
+                    yield unit, fn, cls
+            return
+        dotted = dotted_name(func)
+        if dotted is not None:
+            resolved = project.resolve_function(unit, dotted)
+            if resolved is not None:
+                yield resolved[0], resolved[1], None

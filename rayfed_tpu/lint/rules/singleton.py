@@ -1,0 +1,72 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FED008 ``global-mutable-singleton``: module-level mutable state.
+
+Every module-level registry, cache dict, and lock is process-global:
+under the planned multi-tenant runtime (ROADMAP, "multi-tenant jobs")
+two jobs in one process would share — and corrupt — it. The rule flags
+three shapes: threading synchronization objects (a lock only exists to
+serialize shared state), mutable containers the module itself writes
+to, and ``global``-rebound lazy caches. Constant tables nobody mutates
+are not flagged. The detector is shared with the CLI's
+``--singleton-inventory`` writer (``tools/singleton_inventory.json``,
+the refactor worklist), so a per-site suppression silences the finding
+without hiding the site from the inventory. Sites that are deliberate
+process-wide state (the proxy registry, the metrics registry) suppress
+with a justification comment; the suppression is the refactor's TODO
+marker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Tuple
+
+from rayfed_tpu.lint.core import ProjectRule
+from rayfed_tpu.lint.project import ProjectModel, collect_singletons
+
+_KIND_BLURB = {
+    "lock": "a module-level lock serializes state shared by every job "
+            "in the process",
+    "container": "a module-level mutable container is shared by every "
+                 "job in the process",
+    "cache": "a global-rebound cache is shared by every job in the "
+             "process",
+}
+
+
+class GlobalMutableSingletonRule(ProjectRule):
+    rule_id = "FED008"
+    name = "global-mutable-singleton"
+    summary = (
+        "module-level mutable registries/dicts/locks block the "
+        "multi-tenant refactor (inventoried in "
+        "tools/singleton_inventory.json)"
+    )
+
+    def check_project(
+        self, project: ProjectModel
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        for unit in project.modules:
+            for s in collect_singletons(unit):
+                yield (
+                    unit.path,
+                    s.node,
+                    f"module-level mutable singleton {s.name!r} "
+                    f"({s.kind}): {_KIND_BLURB[s.kind]} — scope it "
+                    f"per-job for the multi-tenant refactor, or suppress "
+                    f"with a justification to keep it on the inventory "
+                    f"worklist",
+                )

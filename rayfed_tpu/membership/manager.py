@@ -40,6 +40,13 @@ contract), and it is never reset — unlike data seq ids it survives epoch
 bumps, so a joiner admitted at sync S knows to recv sync S+1 next.
 """
 
+# fedlint: disable-file=seq-divergence
+# Membership is asymmetric by design: the coordinator broadcasts
+# epoch bumps and collects acks while followers only respond, so
+# sends/gets here are necessarily gated on the local role. Control
+# traffic rides reserved ctl: seq ids outside the data DAG;
+# FED002's lockstep rule is for drivers, not the control plane.
+
 from __future__ import annotations
 
 import logging
@@ -1014,7 +1021,7 @@ def join_handshake(
 
 # -- module singleton wired by fed.init / fed.join ---------------------
 
-_manager: Optional[MembershipManager] = None
+_manager: Optional[MembershipManager] = None  # fedlint: disable=global-mutable-singleton (manager singleton; clear_membership_manager() at shutdown)
 
 
 def set_membership_manager(manager: Optional[MembershipManager]) -> None:

@@ -33,8 +33,8 @@ from rayfed_tpu.config import PartyMeshConfig
 
 logger = logging.getLogger(__name__)
 
-_party_mesh = None
-_party_mesh_config: Optional[PartyMeshConfig] = None
+_party_mesh = None  # fedlint: disable=global-mutable-singleton (mesh cache over the per-process jax runtime; one device set per process)
+_party_mesh_config: Optional[PartyMeshConfig] = None  # fedlint: disable=global-mutable-singleton (mesh cache over the per-process jax runtime; one device set per process)
 
 
 def init_distributed(
@@ -147,8 +147,8 @@ def clear_party_mesh() -> None:
 # (ops.aggregate.psum_by_plan). The registry is process-local and
 # strictly opt-in; nothing engages unless it is populated.
 
-_composed_mesh = None
-_composed_parties: Optional[tuple] = None
+_composed_mesh = None  # fedlint: disable=global-mutable-singleton (mesh cache over the per-process jax runtime; one device set per process)
+_composed_parties: Optional[tuple] = None  # fedlint: disable=global-mutable-singleton (mesh cache over the per-process jax runtime; one device set per process)
 
 
 def compose_party_mesh(parties, devices=None, inner_axes=None,

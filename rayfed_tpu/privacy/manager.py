@@ -28,6 +28,13 @@ when ``config["privacy"]`` is present, torn down by ``fed.shutdown``):
   mirror-counter back-compat pattern the async aggregator uses.
 """
 
+# fedlint: disable-file=seq-divergence
+# Secure-aggregation pairwise mask exchange is inherently
+# role-split (party i sends to j and receives from k by mesh
+# order), so fed traffic is gated on party identity on purpose.
+# Seed exchange uses reserved prv: control keys outside the data
+# DAG; FED002 targets drivers, not this plane.
+
 from __future__ import annotations
 
 import hashlib
@@ -447,8 +454,8 @@ class PrivacyManager:
 # Process singleton + install/uninstall (fed.init / fed.shutdown)
 # ---------------------------------------------------------------------------
 
-_manager_lock = threading.Lock()
-_manager: Optional[PrivacyManager] = None
+_manager_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (privacy-plane singleton; uninstall_privacy() drops it at shutdown)
+_manager: Optional[PrivacyManager] = None  # fedlint: disable=global-mutable-singleton (privacy-plane singleton; uninstall_privacy() drops it at shutdown)
 
 
 def get_privacy_manager() -> Optional[PrivacyManager]:

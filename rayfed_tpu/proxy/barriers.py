@@ -31,6 +31,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, Optional, Type
 
+from rayfed_tpu import sanitize
 from rayfed_tpu._private.constants import PING_SEQ_ID
 from rayfed_tpu._private.global_context import get_global_context
 from rayfed_tpu.exceptions import FedRemoteError
@@ -69,9 +70,9 @@ def _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id) -> None:
 # registry so several jobs' proxies can coexist in one process
 # (ref ``fed/proxy/barriers.py:31-85``: job-suffixed actor names when
 # ``use_global_proxy`` is False).
-_sender_proxy: Optional[SenderProxy] = None
-_receiver_proxy: Optional[ReceiverProxy] = None
-_proxy_registry: Dict[str, object] = {}
+_sender_proxy: Optional[SenderProxy] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
+_receiver_proxy: Optional[ReceiverProxy] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
+_proxy_registry: Dict[str, object] = {}  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
 
 _SENDER_NAME = "SenderProxy"
 _RECEIVER_NAME = "ReceiverProxy"
@@ -120,7 +121,7 @@ def receiver_proxy() -> Optional[ReceiverProxy]:
 # probe, the "mbr:*" membership namespace, resent error envelopes) pass
 # through unchanged, as does everything on membership-free jobs (no fn
 # registered = no behavior change).
-_seq_epoch_fn: Optional[Callable[[], Optional[int]]] = None
+_seq_epoch_fn: Optional[Callable[[], Optional[int]]] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
 
 
 def set_seq_epoch_fn(fn: Callable[[], Optional[int]]) -> None:
@@ -335,6 +336,17 @@ def send(
     collides with it in normal operation — callers driving this function
     directly with that pair get a ``ValueError``."""
     _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id)
+    if (
+        sanitize.enabled()
+        and not is_error
+        and isinstance(downstream_seq_id, int)
+    ):
+        # Probed pre-stamp: the invariant lives in the integer seq space,
+        # keyed per epoch (error envelopes reuse old ids by design).
+        fn = _seq_epoch_fn
+        sanitize.probe_send_seq(
+            dest_party, downstream_seq_id, fn() if fn is not None else None
+        )
     upstream_seq_id = _stamp_epoch(upstream_seq_id)
     downstream_seq_id = _stamp_epoch(downstream_seq_id)
     ctx = get_global_context()

@@ -64,6 +64,7 @@ from typing import Dict, Optional, Tuple
 
 import msgpack
 
+from rayfed_tpu import sanitize
 from rayfed_tpu._private.constants import (
     CODE_INTERNAL_ERROR,
     CODE_SHM_UNAVAILABLE,
@@ -510,6 +511,10 @@ class _PyShmRing:
             raise ValueError("shm descriptor out of range")
         pos = off - _CHUNK_HDR
         magic, state, size = self._chunk(pos)
+        if magic == _CHUNK_MAGIC:
+            # Sanitizer sees the state word before the generic rejection:
+            # a RELEASED chunk here is a double-adopt/use-after-release.
+            sanitize.probe_shm_adopt(state, _ST_INFLIGHT, off)
         if (
             magic != _CHUNK_MAGIC
             or state != _ST_INFLIGHT
@@ -529,9 +534,10 @@ class _PyShmRing:
         pos = off - _CHUNK_HDR
         if pos < 0 or pos % _ALIGN or pos >= self.cap:
             raise ValueError("shm cancel offset out of range")
-        magic, _state, _size = self._chunk(pos)
+        magic, state, _size = self._chunk(pos)
         if magic != _CHUNK_MAGIC:
             raise ValueError("shm cancel offset not a chunk")
+        sanitize.probe_shm_cancel(state, _ST_INFLIGHT, off)
         self._set_state(pos, _ST_RELEASED)
 
     def occupancy(self) -> Tuple[int, int]:

@@ -32,7 +32,7 @@ from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
 
-from rayfed_tpu import tracing
+from rayfed_tpu import sanitize, tracing
 from rayfed_tpu._private import serialization
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
 from rayfed_tpu._private.constants import (
@@ -75,13 +75,13 @@ CONTROL_NAMESPACES: Tuple[str, ...] = (
 # joiner may legitimately send before a slow member has applied the
 # admitting sync, and a roster-complement sweep would reap (and
 # tombstone) those frames, wedging the eventual recv.
-_control_handlers: Dict[Tuple[str, str], Callable] = {}
-_evicted_fns: Dict[str, Callable[[], Dict[str, int]]] = {}
-_hooks_lock = threading.Lock()
+_control_handlers: Dict[Tuple[str, str], Callable] = {}  # fedlint: disable=global-mutable-singleton (store/hook registries scoped to the proxy lifecycle; stopped with the proxies)
+_evicted_fns: Dict[str, Callable[[], Dict[str, int]]] = {}  # fedlint: disable=global-mutable-singleton (store/hook registries scoped to the proxy lifecycle; stopped with the proxies)
+_hooks_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (store/hook registries scoped to the proxy lifecycle; stopped with the proxies)
 
 # Every live store, so an epoch bump can purge an evicted party's
 # parked frames across all transports/jobs in this process.
-_stores: "weakref.WeakSet[RendezvousStore]" = weakref.WeakSet()
+_stores: "weakref.WeakSet[RendezvousStore]" = weakref.WeakSet()  # fedlint: disable=global-mutable-singleton (store/hook registries scoped to the proxy lifecycle; stopped with the proxies)
 
 
 def register_control_prefix(
@@ -559,6 +559,11 @@ class RendezvousStore:
             if waiter is None:
                 # An error envelope substituting already-arrived data
                 # overwrites the slot (sender reuses the same seq ids).
+                if sanitize.enabled() and key in self._arrived:
+                    parked_header, _parked = self._arrived[key]
+                    sanitize.probe_rendezvous_reoccupation(
+                        key, parked_header.get("src"), header.get("src")
+                    )
                 self._arrived[key] = (header, payload)
             else:
                 self._mark_consumed(key)

@@ -63,6 +63,7 @@ from typing import Callable, Dict, List, Optional
 
 import msgpack
 
+from rayfed_tpu import sanitize
 from rayfed_tpu.proxy.tcp import sockio, wire
 from rayfed_tpu.proxy.tcp.pipeline import _Inflight
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
@@ -407,9 +408,9 @@ class Reactor(threading.Thread):
 # Process-global reactor pool (refcounted across proxies)
 # ---------------------------------------------------------------------------
 
-_pool_lock = threading.Lock()
-_pool: List[Reactor] = []
-_pool_refs = 0
+_pool_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (shared reactor pool, refcounted via acquire/release_reactors)
+_pool: List[Reactor] = []  # fedlint: disable=global-mutable-singleton (shared reactor pool, refcounted via acquire/release_reactors)
+_pool_refs = 0  # fedlint: disable=global-mutable-singleton (shared reactor pool, refcounted via acquire/release_reactors)
 
 
 def acquire_reactors(n: int = 1) -> List[Reactor]:
@@ -718,6 +719,8 @@ class ReactorLane:
         if not ok:
             self._window.release()
             return False
+        if sanitize.enabled():
+            sanitize.probe_inline_busy_set(id(self))
         chunks = _frame_chunks(job.header, job.buffers)
         total = sum(c.nbytes if isinstance(c, memoryview) else len(c)
                     for c in chunks)
@@ -725,6 +728,8 @@ class ReactorLane:
         if n < 0:
             with self._lock:
                 self._inline_busy = False
+            if sanitize.enabled():
+                sanitize.probe_inline_busy_clear(id(self))
             err = ConnectionError(
                 f"send failed: {os.strerror(-n) if n != -1 else 'io error'}"
             )
@@ -735,11 +740,15 @@ class ReactorLane:
             with self._lock:
                 self._inline_busy = False
                 self._outbox.extendleft(reversed(rem))
+            if sanitize.enabled():
+                sanitize.probe_inline_busy_clear(id(self))
             self._reactor.run_soon(self._resume_write)
         else:
             with self._lock:
                 self._inline_busy = False
                 backlog = bool(self._pending or self._outbox)
+            if sanitize.enabled():
+                sanitize.probe_inline_busy_clear(id(self))
             _m_inline_sends.inc()
             if backlog:
                 self._reactor.run_soon(self._pump)
@@ -758,6 +767,20 @@ class ReactorLane:
             self._outbox.clear()
             sock, fd = self._sock, self.fd
             self._sock, self.fd = None, -1
+        # An inline send may have captured the fd under the lock *before*
+        # _closed was set and still be inside its nonblocking writev.
+        # Closing the socket now would free the descriptor mid-write: the
+        # kernel can hand the same fd number to an unrelated file, and
+        # the stray writev then corrupts it. Drain the inline writer
+        # (bounded — it never blocks, so this is microseconds in
+        # practice) before releasing the descriptor.
+        deadline = time.monotonic() + 0.5
+        while True:
+            with self._lock:
+                busy = self._inline_busy
+            if not busy or time.monotonic() >= deadline:
+                break
+            time.sleep(0.0005)
         _m_open_lanes.inc(-1)
         err = ConnectionError("sender stopped")
         for job in jobs:
@@ -779,6 +802,8 @@ class ReactorLane:
     def _pump(self) -> None:
         """Move pending jobs into the ring as window slots allow; dial if
         the connection is down. Loop thread only."""
+        if sanitize.enabled():
+            sanitize.probe_reactor_affinity(self._reactor, "ReactorLane._pump")
         with self._lock:
             if self._closed or self._inline_busy:
                 return
@@ -826,6 +851,10 @@ class ReactorLane:
             return list(self._outbox)
 
     def on_flushed(self, result: int) -> None:
+        if sanitize.enabled():
+            sanitize.probe_reactor_affinity(
+                self._reactor, "ReactorLane.on_flushed"
+            )
         if result < 0:
             self._on_break(ConnectionError(
                 f"send failed: {os.strerror(-result)}"

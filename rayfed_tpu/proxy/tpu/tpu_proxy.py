@@ -56,8 +56,8 @@ logger = logging.getLogger(__name__)
 
 _SAME_MESH_CAP = 1024  # leak bound: failed sends evict via on_done
 
-_same_mesh_lock = threading.Lock()
-_same_mesh_table: "OrderedDict[int, object]" = OrderedDict()
+_same_mesh_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (same-mesh table over the per-process TPU runtime)
+_same_mesh_table: "OrderedDict[int, object]" = OrderedDict()  # fedlint: disable=global-mutable-singleton (same-mesh table over the per-process TPU runtime)
 _same_mesh_tokens = itertools.count(1)
 
 

@@ -412,7 +412,7 @@ class InjectingSenderProxy:
 
 # -- install / uninstall at the barriers seam -------------------------
 
-_installed: Optional[InjectingSenderProxy] = None
+_installed: Optional[InjectingSenderProxy] = None  # fedlint: disable=global-mutable-singleton (injector install flag; uninstall() clears it at shutdown)
 
 
 def install(schedule: FaultSchedule, party: str) -> InjectingSenderProxy:

@@ -275,7 +275,7 @@ class LivenessMonitor:
 
 # -- module singleton wired by fed.init -------------------------------
 
-_monitor: Optional[LivenessMonitor] = None
+_monitor: Optional[LivenessMonitor] = None  # fedlint: disable=global-mutable-singleton (monitor singleton; stop_monitor() clears it at shutdown)
 
 
 def start_monitor(

@@ -193,7 +193,7 @@ def submit_request(handle: ServeHandle, prompt, **opts) -> FedObject:
 # Job-level default config (config['serving'] from fed.init), following
 # the topology.set_default pattern: every driver reads the same dict, so
 # every party builds the same engine.
-_default_serving_config: Optional[Dict[str, Any]] = None
+_default_serving_config: Optional[Dict[str, Any]] = None  # fedlint: disable=global-mutable-singleton (default serving config; reset to None at shutdown)
 
 
 def set_default_serving_config(d: Optional[Dict[str, Any]]) -> None:

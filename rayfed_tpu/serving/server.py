@@ -683,8 +683,8 @@ class InferenceServer:
 
 # -- process-local server registry (one per serve() name) --------------------
 
-_registry_lock = threading.Lock()
-_servers: Dict[str, InferenceServer] = {}
+_registry_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (server registry; stop_all_servers() drains it at shutdown)
+_servers: Dict[str, InferenceServer] = {}  # fedlint: disable=global-mutable-singleton (server registry; stop_all_servers() drains it at shutdown)
 
 
 def register_server(server: InferenceServer) -> None:

@@ -41,13 +41,13 @@ from rayfed_tpu.telemetry.config import TelemetryConfig
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()
-_agent = None
-_collector = None
-_http = None
-_job_name: Optional[str] = None
-_party: Optional[str] = None
-_we_enabled_tracing = False
+_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_agent = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_collector = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_http = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_job_name: Optional[str] = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_party: Optional[str] = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_we_enabled_tracing = False  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
 
 
 def resolve_collector(cfg: TelemetryConfig, parties) -> str:

@@ -457,8 +457,8 @@ def render_prometheus(
 # Process-global registry.
 # ---------------------------------------------------------------------------
 
-_registry = MetricsRegistry()
-_registry_lock = threading.Lock()
+_registry = MetricsRegistry()  # fedlint: disable=global-mutable-singleton (metrics registry is process-global by contract (docs/observability.md))
+_registry_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (metrics registry is process-global by contract (docs/observability.md))
 
 
 def get_registry() -> MetricsRegistry:

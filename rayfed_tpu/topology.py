@@ -319,8 +319,8 @@ def plan_buffer(slots: Iterable[str]) -> TopologyPlan:
 # Job-level default (config: aggregation.topology / aggregation.group_size)
 # ---------------------------------------------------------------------------
 
-_default_lock = threading.Lock()
-_default: Dict[str, object] = {"topology": "auto", "group_size": None}
+_default_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (default topology; reset_default() at shutdown)
+_default: Dict[str, object] = {"topology": "auto", "group_size": None}  # fedlint: disable=global-mutable-singleton (default topology; reset_default() at shutdown)
 
 
 def set_default(topology: str = "auto",

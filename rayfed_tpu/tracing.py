@@ -32,17 +32,17 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
 
-_enabled = False
-_use_jax_annotations = False
-_lock = threading.Lock()
+_enabled = False  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
+_use_jax_annotations = False  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
+_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
 _MAX_SPANS = 10000
-_spans: Deque["Span"] = deque(maxlen=_MAX_SPANS)
+_spans: Deque["Span"] = deque(maxlen=_MAX_SPANS)  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
 # Monotonic append counter: every span gets the next index so the
 # telemetry agent can harvest "spans since my last push" even though
 # the ring drops old entries (rayfed_tpu/telemetry/agent.py).
-_span_seq = 0
+_span_seq = 0  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
 _MAX_REQUEST_EVENTS = 20000
-_request_events: Deque["RequestEvent"] = deque(maxlen=_MAX_REQUEST_EVENTS)
+_request_events: Deque["RequestEvent"] = deque(maxlen=_MAX_REQUEST_EVENTS)  # fedlint: disable=global-mutable-singleton (trace buffer is process-global by contract; drained via snapshot())
 
 
 @dataclass

@@ -1,0 +1,49 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED010 blocking-call-in-reactor (expected: 2).
+
+Callbacks handed to ``run_soon``/``add_ticker`` execute on the reactor
+loop thread, which services every connection: a ``time.sleep`` or a
+``fed.get`` there stalls all lanes at once.
+"""
+
+import time
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def discover():
+    return ["alice", "bob"]
+
+
+def poll_peers(now):
+    # BAD: fed.get blocks the loop thread until the peer's bytes arrive.
+    peers = fed.get(discover.remote())
+    return peers
+
+
+class MetricsAgent:
+    def __init__(self, reactor):
+        self._reactor = reactor
+
+    def start(self):
+        self._reactor.run_soon(self._flush)
+        self._reactor.add_ticker(poll_peers)
+
+    def _flush(self):
+        # BAD: sleeping on the loop thread stalls every lane in the pool.
+        time.sleep(0.2)

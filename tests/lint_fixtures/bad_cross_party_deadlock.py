@@ -1,0 +1,52 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED007 cross-party-deadlock (expected findings: 2).
+
+Two ``.party()``-pinned tasks whose bodies ``fed.get`` their argument
+are handed each other's result variable: each party's worker blocks in
+the pull that gates the send the peer's pull is waiting on.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def exchange(peer_value):
+    # The in-task pull holds this party's worker until the peer's bytes
+    # arrive (unlike a plain FedObject argument, which the owner pushes).
+    latest = fed.get(peer_value)
+    return latest + 1
+
+
+def main():
+    party = sys.argv[1]
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+    )
+    # BAD: ping's task (alice) pulls pong's bytes while pong's task
+    # (bob) pulls ping's — a mutual wait cycle; any retry or reordering
+    # wedges both parties with no error.
+    ping = exchange.party("alice").remote(pong)  # noqa: F821
+    pong = exchange.party("bob").remote(ping)
+    print(fed.get([ping, pong]))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

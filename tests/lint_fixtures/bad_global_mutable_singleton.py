@@ -1,0 +1,38 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED008 global-mutable-singleton (expected: 2).
+
+A module-level cache dict the module mutates, serialized by a
+module-level lock: both are process-global, so two jobs sharing the
+process would share (and corrupt) them.
+"""
+
+import threading
+
+# BAD: mutable container written by remember() below.
+_round_cache = {}
+# BAD: a module-level lock only exists to serialize shared state.
+_cache_lock = threading.Lock()
+
+
+def remember(round_id, weights):
+    with _cache_lock:
+        _round_cache[round_id] = weights
+
+
+def lookup(round_id):
+    with _cache_lock:
+        return _round_cache.get(round_id)

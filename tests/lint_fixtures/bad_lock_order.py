@@ -1,0 +1,44 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED011 lock-order-inconsistency (expected: 2).
+
+Two instance locks taken in opposite orders on two static paths: the
+classic ABBA deadlock, needing only unlucky scheduling between a
+recording thread and an invalidating thread.
+"""
+
+import threading
+
+
+class RouteTable:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+        self._stats = {}
+        self._routes = {}
+
+    def record(self, route, n):
+        # Path 1: stats lock, THEN route lock.
+        with self._stats_lock:
+            with self._route_lock:
+                self._stats[route] = self._stats.get(route, 0) + n
+
+    def invalidate(self, route):
+        # BAD path 2: route lock, THEN stats lock — opposite order.
+        with self._route_lock:
+            with self._stats_lock:
+                self._routes.pop(route, None)
+                self._stats.pop(route, None)

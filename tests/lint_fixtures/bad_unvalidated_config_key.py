@@ -1,0 +1,49 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED009 unvalidated-config-key (expected: 2).
+
+``*Config.from_dict`` drops unknown keys silently: the typo'd knob
+never errors and never takes effect — the job just runs with the
+default.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+def main():
+    party = sys.argv[1]
+    comm = {
+        # BAD: typo for 'timeout_in_ms'; silently dropped at runtime.
+        "timeout_in_msx": 20000,
+        "serializing_allowed_list": {"numpy.core.numeric": ["*"]},
+    }
+    config = {
+        "cross_silo_comm": comm,
+        # BAD: typo for 'barrier_on_initializing'.
+        "barrier_on_init": True,
+    }
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+        config=config,
+    )
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

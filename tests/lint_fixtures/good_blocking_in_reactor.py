@@ -1,0 +1,45 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED010 negative — blocking work leaves the loop.
+
+Callbacks only enqueue: blocking uploads run on an executor thread, and
+waits carry timeouts. The ``upload_blocking`` body may sleep because
+nothing on the loop thread ever calls it directly.
+"""
+
+import time
+
+
+def upload_blocking(batch):
+    time.sleep(0.2)  # runs on the pool thread, not the reactor loop
+    return len(batch)
+
+
+class MetricsAgent:
+    def __init__(self, reactor, pool):
+        self._reactor = reactor
+        self._pool = pool
+        self._batch = []
+
+    def start(self):
+        self._reactor.run_soon(self._flush)
+
+    def _flush(self):
+        # Hand the blocking upload to the worker pool; the callback
+        # itself returns immediately.
+        future = self._pool.submit(upload_blocking, list(self._batch))
+        self._batch.clear()
+        return future

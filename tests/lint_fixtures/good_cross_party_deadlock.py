@@ -1,0 +1,51 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED007 negative — staggered acyclic exchange.
+
+The same pulling task is fine when the wait graph is a chain: each pull
+waits only on work already produced, so no cycle exists.
+"""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+@fed.remote
+def produce():
+    return 1
+
+
+@fed.remote
+def refine(peer_value):
+    return fed.get(peer_value) + 1
+
+
+def main():
+    party = sys.argv[1]
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+    )
+    seed = produce.party("alice").remote()
+    step = refine.party("bob").remote(seed)
+    out = refine.party("alice").remote(step)
+    print(fed.get(out))
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED008 negative — job-scoped state, constant tables.
+
+Mutable state lives on an instance a job owns; the only module-level
+values are immutable (or never-mutated) constants, which the rule does
+not flag.
+"""
+
+import threading
+
+# A constant lookup table nobody mutates is not a singleton hazard.
+_DEFAULT_PARTIES = ("alice", "bob")
+_KIND_LABELS = {"lock": "serializer", "container": "registry"}
+
+
+class RoundCache:
+    """Per-job cache: each job constructs its own instance."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rounds = {}
+
+    def remember(self, round_id, weights):
+        with self._lock:
+            self._rounds[round_id] = weights
+
+    def lookup(self, round_id):
+        with self._lock:
+            return self._rounds.get(round_id)

@@ -1,0 +1,46 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED011 negative — one global lock order.
+
+Every path that needs both locks takes them in the same order, and
+single-lock paths are always safe.
+"""
+
+import threading
+
+
+class RouteTable:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._route_lock = threading.Lock()
+        self._stats = {}
+        self._routes = {}
+
+    def record(self, route, n):
+        with self._stats_lock:
+            with self._route_lock:
+                self._stats[route] = self._stats.get(route, 0) + n
+
+    def invalidate(self, route):
+        # Same global order: stats before route, everywhere.
+        with self._stats_lock:
+            with self._route_lock:
+                self._routes.pop(route, None)
+                self._stats.pop(route, None)
+
+    def stat(self, route):
+        with self._stats_lock:
+            return self._stats.get(route, 0)

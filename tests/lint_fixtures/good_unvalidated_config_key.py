@@ -1,0 +1,46 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+
+"""fedlint fixture: FED009 negative — every key is in the schema."""
+
+import sys
+
+import rayfed_tpu as fed
+
+
+def main():
+    party = sys.argv[1]
+    config = {
+        "cross_silo_comm": {
+            "timeout_in_ms": 20000,
+            "retry_policy": {
+                "max_attempts": 5,
+                "initial_backoff_ms": 100,
+            },
+        },
+        "barrier_on_initializing": True,
+    }
+    fed.init(
+        addresses={"alice": "127.0.0.1:9001", "bob": "127.0.0.1:9002"},
+        party=party,
+        config=config,
+    )
+    transport = config.get("transport")
+    print(transport)
+    fed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

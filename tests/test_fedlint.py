@@ -48,6 +48,11 @@ BAD_FIXTURES = {
     "bad_dangling_fedobject.py": ("FED004", 2),
     "bad_reserved_seq_id.py": ("FED005", 2),
     "bad_insecure_aggregate.py": ("FED006", 2),
+    "bad_cross_party_deadlock.py": ("FED007", 2),
+    "bad_global_mutable_singleton.py": ("FED008", 2),
+    "bad_unvalidated_config_key.py": ("FED009", 2),
+    "bad_blocking_in_reactor.py": ("FED010", 2),
+    "bad_lock_order.py": ("FED011", 2),
 }
 
 GOOD_FIXTURES = [
@@ -57,6 +62,11 @@ GOOD_FIXTURES = [
     "good_dangling_fedobject.py",
     "good_reserved_seq_id.py",
     "good_insecure_aggregate.py",
+    "good_cross_party_deadlock.py",
+    "good_global_mutable_singleton.py",
+    "good_unvalidated_config_key.py",
+    "good_blocking_in_reactor.py",
+    "good_lock_order.py",
     "suppressed.py",
 ]
 
@@ -142,6 +152,101 @@ def test_cli_json_format(tmp_path):
     assert {f["rule_id"] for f in payload["findings"]} == {"FED005"}
     for f in payload["findings"]:
         assert {"path", "line", "col", "rule_id", "rule_name", "message"} <= set(f)
+
+
+def test_cli_sarif_format():
+    proc = _run_cli("--format", "sarif", _fixture("bad_lock_order.py"))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fedlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {r.rule_id for r in ALL_RULES} <= rule_ids
+    results = run["results"]
+    assert {r["ruleId"] for r in results} == {"FED011"}
+    for r in results:
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad_lock_order.py")
+        assert loc["region"]["startLine"] >= 1
+        assert r["message"]["text"]
+
+
+def test_cli_singleton_inventory(tmp_path):
+    out = tmp_path / "inventory.json"
+    proc = _run_cli(
+        _fixture("bad_global_mutable_singleton.py"),
+        "--singleton-inventory", str(out),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(out.read_text())
+    assert payload["version"] == 1
+    names = {s["name"] for s in payload["singletons"]}
+    assert names == {"_round_cache", "_cache_lock"}
+    for s in payload["singletons"]:
+        assert {"module", "path", "name", "line", "kind", "value",
+                "mutators"} <= set(s)
+
+
+def test_repo_singleton_inventory_is_fresh(tmp_path):
+    """tools/singleton_inventory.json (the multi-tenant worklist) must
+    match what the detector reports today — regenerate it when module
+    globals are added or removed."""
+    out = tmp_path / "inventory.json"
+    # Relative path on purpose: the committed inventory stores
+    # repo-relative paths (the CLI runs with cwd=REPO here).
+    proc = _run_cli("rayfed_tpu", "--singleton-inventory", str(out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fresh = json.loads(out.read_text())
+    committed = json.loads(
+        open(os.path.join(REPO, "tools", "singleton_inventory.json")).read()
+    )
+    assert fresh == committed, (
+        "tools/singleton_inventory.json is stale; regenerate with "
+        "`python -m rayfed_tpu.lint rayfed_tpu --singleton-inventory "
+        "tools/singleton_inventory.json`"
+    )
+
+
+def test_self_lint_is_clean():
+    """The framework lints itself clean: every finding is either fixed
+    or suppressed in place with a justification."""
+    result = lint_paths([os.path.join(REPO, "rayfed_tpu")])
+    assert not result.errors, [e.render() for e in result.errors]
+    assert not result.findings, [f.render() for f in result.findings]
+
+
+def test_schema_matches_config_dataclasses():
+    """lint/schema.py is a static mirror of the runtime config
+    dataclasses; this is the tripwire that keeps them in sync."""
+    import dataclasses
+    import importlib
+
+    from rayfed_tpu.lint import schema
+
+    modules = {
+        "CheckpointConfig": "rayfed_tpu.checkpoint",
+        "CrossSiloMessageConfig": "rayfed_tpu.config",
+        "FailoverConfig": "rayfed_tpu.membership.config",
+        "LivenessConfig": "rayfed_tpu.resilience.liveness",
+        "MembershipConfig": "rayfed_tpu.config",
+        "PartyMeshConfig": "rayfed_tpu.config",
+        "PrivacyConfig": "rayfed_tpu.privacy.config",
+        "RetryPolicy": "rayfed_tpu.resilience.retry",
+        "ServingConfig": "rayfed_tpu.config",
+        "TcpCrossSiloMessageConfig": "rayfed_tpu.config",
+        "TelemetryConfig": "rayfed_tpu.telemetry.config",
+    }
+    assert set(modules) == set(schema.CONFIG_CLASS_FIELDS)
+    for name, module in modules.items():
+        cls = getattr(importlib.import_module(module), name)
+        real = {f.name for f in dataclasses.fields(cls)}
+        mirror = set(schema.CONFIG_CLASS_FIELDS[name])
+        assert mirror == real, (
+            f"lint/schema.py CONFIG_CLASS_FIELDS[{name!r}] is out of "
+            f"sync: extra={sorted(mirror - real)} "
+            f"missing={sorted(real - mirror)}"
+        )
 
 
 def test_cli_disable_silences_rule():

@@ -1,0 +1,169 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""FedSanitizer overhead gate (docs/sanitizer.md).
+
+Runs a 3-party FedAvg round loop (spawned processes, real transport)
+in paired sanitizer-off / sanitizer-on windows, toggled at identical
+program points on every party, and FAILS LOUDLY — exit code 1 — when
+the enabled probes cost more than the budget. The sanitizer's contract
+is "cheap enough to leave on in every test run": each probe is a flag
+test plus a dict lookup at a seam the frame already crosses, so the
+budget is generous headroom, not a target.
+
+A probe trip during the sanitized windows crashes the party outright
+(SanitizerError), so this gate doubles as a smoke check that a clean
+FedAvg sails through every probe.
+
+Budgets:
+
+  FEDTPU_SANITIZE_BUDGET_PCT   default 10.0 — sanitized round-time
+                               overhead cap (median over pairs).
+  FEDTPU_SANITIZE_ROUNDS       default 30 rounds per window.
+  FEDTPU_SANITIZE_PAIRS        default 3 off/on pairs.
+  FEDTPU_SANITIZE_WALL_BUDGET_S  default 300 — cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+_PARTIES = ("alice", "bob", "carol")
+
+
+def _sanitize_party(party, addresses, transport, result_path, rounds, pairs):
+    import json
+
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu import sanitize
+    from rayfed_tpu.ops.aggregate import tree_mean
+
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "cross_silo_comm": dict(bench._FAST_RETRY),
+            "transport": transport,
+        },
+        job_name=f"sanitize-check-{transport}",
+        logging_level="error",
+    )
+
+    @fed.remote
+    def contrib(seed, r):
+        rng = np.random.default_rng(seed + r)
+        return {"w": rng.standard_normal(2048).astype(np.float32)}
+
+    @fed.remote
+    def fedavg(wa, wb, wc):
+        return tree_mean(wa, wb, wc)
+
+    seeds = {p: i for i, p in enumerate(_PARTIES)}
+
+    def window(enabled: bool, r0: int) -> float:
+        """Per-round wall ms over one window. The toggle happens at the
+        same program point on every party — probes only ever see frames
+        from identically-configured peers."""
+        if enabled:
+            sanitize.enable()
+        else:
+            sanitize.disable()
+        t0 = time.monotonic()
+        for r in range(rounds):
+            pushes = [
+                contrib.party(p).remote(seeds[p], r0 + r) for p in _PARTIES
+            ]
+            fed.get(fedavg.party("alice").remote(*pushes))
+        return (time.monotonic() - t0) * 1000.0 / rounds
+
+    window(False, 0)  # warmup: compile, dial, settle the lanes
+    off_ms, on_ms = [], []
+    r0 = rounds
+    for _ in range(pairs):
+        off_ms.append(window(False, r0))
+        r0 += rounds
+        on_ms.append(window(True, r0))
+        r0 += rounds
+
+    trips = dict(sanitize.trips())
+    assert trips == {}, f"sanitizer tripped during clean FedAvg: {trips}"
+    fed.shutdown()
+
+    if party == "alice":
+        overhead = statistics.median(
+            (on - off) / off * 100.0 for off, on in zip(off_ms, on_ms)
+        )
+        with open(result_path, "w") as f:
+            json.dump(
+                {
+                    "sanitize_overhead_pct": overhead,
+                    "sanitize_off_ms": off_ms,
+                    "sanitize_on_ms": on_ms,
+                },
+                f,
+            )
+
+
+def main() -> int:
+    budget_pct = float(os.environ.get("FEDTPU_SANITIZE_BUDGET_PCT", "10.0"))
+    rounds = int(os.environ.get("FEDTPU_SANITIZE_ROUNDS", "30"))
+    pairs = int(os.environ.get("FEDTPU_SANITIZE_PAIRS", "3"))
+    wall_budget_s = float(
+        os.environ.get("FEDTPU_SANITIZE_WALL_BUDGET_S", "300")
+    )
+
+    t0 = time.monotonic()
+    with bench._cpu_forced():
+        res = bench._run_two_party(
+            _sanitize_party, "tcp", (rounds, pairs),
+            timeout_s=wall_budget_s, parties=_PARTIES,
+        )
+    elapsed = time.monotonic() - t0
+
+    overhead = res["sanitize_overhead_pct"]
+    print(
+        f"overhead={overhead:.2f}% "
+        f"off={['%.2f' % x for x in res['sanitize_off_ms']]}ms "
+        f"on={['%.2f' % x for x in res['sanitize_on_ms']]}ms "
+        f"in {elapsed:.0f}s",
+        flush=True,
+    )
+
+    if overhead > budget_pct:
+        print(
+            f"SANITIZE REGRESSION: sanitized round time is "
+            f"{overhead:.2f}% over baseline (budget {budget_pct:.1f}%) — "
+            f"probes must stay a flag test plus a dict lookup at seams "
+            f"the frame already crosses; something started doing "
+            f"per-payload work (hashing? tree walks on the send path?).",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"sanitize gate passed in {elapsed:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
