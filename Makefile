@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint sanitize chaos latency scale dma shm serve async churn obs privacy ha clean
+.PHONY: native test lint sanitize chaos latency scale dma shm serve async churn obs privacy ha wan clean
 
 native:
 	python setup.py build_ext --inplace
@@ -135,6 +135,18 @@ privacy:
 ha:
 	JAX_PLATFORMS=cpu python tools/ha_check.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_ha.py -q
+
+# WAN gate (docs/resilience.md): 3 spawned parties over an in-proxy
+# emulated 50ms/100Mbit link (LinkProfile shaper) with frame crc and
+# adaptive deadlines on — wan_round_ms must stay latency-bound under
+# FEDTPU_WAN_ROUND_BUDGET_MS (and ABOVE the shaper-is-alive floor),
+# link_rtt_ms must show the LinkHealth estimator converging on the
+# emulated RTT, plus the WAN unit + chaos tests (link shaping, crc
+# NACK/retransmit, lane re-promotion, bounded duplicates). Mirrors the
+# `wan` job in .github/workflows/tests.yml.
+wan:
+	JAX_PLATFORMS=cpu python tools/wan_check.py
+	JAX_PLATFORMS=cpu python -m pytest tests/test_wan.py -q
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

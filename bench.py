@@ -1901,6 +1901,90 @@ def _ha_party(party, addresses, transport, result_path, rounds):
     fed.shutdown()
 
 
+_WAN3 = ("alice", "bob", "carol")
+
+
+def _wan_party(party, addresses, transport, result_path, rounds):
+    """WAN-emulation stage (docs/resilience.md): a 3-party FedAvg where
+    every edge rides a netem-style emulated 50ms/100Mbit link (the
+    in-proxy LinkProfile shaper — deterministic latency + token-bucket
+    pacing, no root netem needed), with frame crc and adaptive deadlines
+    on: the self-healing transport's steady-state WAN posture. Headline
+    metrics tools/wan_check.py gates:
+
+      wan_round_ms — median FedAvg round latency over the shaped link
+                     (floor: ~2 x 50ms one-way latency per round trip).
+      link_rtt_ms  — worst per-peer smoothed RTT the LinkHealth
+                     estimator converged to (liveness ping round-trips
+                     through the shaper): must see the emulated
+                     latency, or adaptive deadlines are flying blind.
+    """
+    import statistics
+
+    import numpy as np
+
+    import rayfed_tpu as fed
+    from rayfed_tpu.ops.aggregate import elastic_weighted_mean
+    from rayfed_tpu.resilience import linkhealth
+
+    bases = {"alice": 1.0, "bob": 2.0, "carol": 3.0}
+    fed.init(
+        addresses=addresses,
+        party=party,
+        config={
+            "barrier_on_initializing": True,
+            "cross_silo_comm": dict(
+                _FAST_RETRY,
+                frame_crc=True,
+                adaptive_timeouts=True,
+                recv_timeout_in_ms=20000,
+            ),
+            "transport": transport,
+            "resilience": {
+                "fault_schedule": {
+                    "seed": 17,
+                    "links": [{"latency_ms": 50, "rate_mbit": 100}],
+                },
+                "liveness": {
+                    "interval_ms": 250, "suspect_after": 4,
+                    "dead_after": 8, "timeout_ms": 2000,
+                },
+            },
+        },
+        job_name=f"bench-wan-{transport}",
+        logging_level="error",
+    )
+
+    @fed.remote
+    def contrib(base, r):
+        # 256KB per contribution: ~2ms of 100Mbit pipe per edge, so the
+        # round is latency-bound (the WAN regime), not bandwidth-bound.
+        return {"g": np.full((1 << 16,), base * (r + 1), np.float32)}
+
+    per_round_ms = []
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        objs = {p: contrib.party(p).remote(bases[p], r) for p in _WAN3}
+        got = fed.get([objs[p] for p in _WAN3], timeout=60.0)
+        agg = elastic_weighted_mean(dict(zip(_WAN3, got)))
+        assert np.isfinite(np.asarray(agg["g"]).sum())
+        if r > 0:  # round 0 pays actor init + first-push setup
+            per_round_ms.append((time.perf_counter() - t0) * 1e3)
+    _progress(party, "rounds done; shutting down")
+    if party == "alice":
+        health = linkhealth.get_health().get_stats()
+        link_rtt_ms = max(
+            (s["srtt_ms"] for s in health.values()), default=0.0
+        )
+        with open(result_path, "w") as f:
+            json.dump({
+                "round_ms": statistics.median(per_round_ms),
+                "link_rtt_ms": link_rtt_ms,
+                "wan_rounds": rounds,
+            }, f)
+    fed.shutdown()
+
+
 _OBS3 = ("alice", "bob", "carol")
 
 
@@ -2487,6 +2571,19 @@ def main() -> None:
             "ha_rounds_lost": "ha_rounds_lost",
             "ha_failed_over": "ha_failed_over",
             "ha_rounds": "ha_rounds",
+        },
+    ))
+    # WAN emulation (docs/resilience.md): 3-party FedAvg over an
+    # in-proxy 50ms/100Mbit shaped link with frame crc + adaptive
+    # deadlines on. tools/wan_check.py gates the round latency and the
+    # LinkHealth estimator's convergence on the emulated RTT.
+    result.update(_bench_stage(
+        _wan_party, "round_ms", "FEDTPU_BENCH_WAN_ROUNDS", 8,
+        [("tcp", "wan_round_ms")], cpu_force=True, parties=_WAN3,
+        timeout_s=300, digits=1,
+        extra_fields={
+            "link_rtt_ms": "link_rtt_ms",
+            "wan_rounds": "wan_rounds",
         },
     ))
     # Telemetry plane (docs/observability.md): paired on/off windows

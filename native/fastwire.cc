@@ -1435,6 +1435,36 @@ static PyObject *fastwire_shm_ring_cancel(PyObject *self, PyObject *args) {
 
 /* shm_ring_occupancy(ring) -> (used_bytes, capacity)
  * Creator-side view after a reclaim pass (telemetry + wait-for-space). */
+/* shm_ring_chunk_state(ring, off) -> int
+ * Atomic read of a chunk's state word (0 inflight, 1 released) so the
+ * sender can reclaim ONLY still-inflight chunks after a peer death —
+ * cancelling a chunk the receiver already released would be a
+ * double-release (the sanitizer treats it as one). */
+static PyObject *fastwire_shm_ring_chunk_state(PyObject *self,
+                                               PyObject *args) {
+    PyObject *ring_obj;
+    unsigned long long off;
+    if (!PyArg_ParseTuple(args, "OK", &ring_obj, &off)) return NULL;
+    const char *why = NULL;
+    if (shm_check_ring(ring_obj, &why) < 0) {
+        PyErr_SetString(PyExc_ValueError, why);
+        return NULL;
+    }
+    ShmRing *r = (ShmRing *)ring_obj;
+    if (off < SHM_CHUNK_HDR || off % SHM_ALIGN != 0 || off > r->cap) {
+        PyErr_SetString(PyExc_ValueError, "shm chunk offset out of range");
+        return NULL;
+    }
+    ShmChunkHdr *c =
+        (ShmChunkHdr *)(shm_data(r) + (size_t)off - SHM_CHUNK_HDR);
+    if (c->magic != SHM_CHUNK_MAGIC) {
+        PyErr_SetString(PyExc_ValueError, "shm offset not a chunk");
+        return NULL;
+    }
+    return PyLong_FromUnsignedLong(
+        __atomic_load_n(&c->state, __ATOMIC_ACQUIRE));
+}
+
 static PyObject *fastwire_shm_ring_occupancy(PyObject *self, PyObject *args) {
     PyObject *ring_obj;
     if (!PyArg_ParseTuple(args, "O", &ring_obj)) return NULL;
@@ -1475,10 +1505,46 @@ static PyObject *fastwire_shm_ring_close(PyObject *self, PyObject *args) {
 }
 
 /* ------------------------------------------------------------------ */
+/* crc32c (Castagnoli) — frame-integrity fast path                     */
+/* ------------------------------------------------------------------ */
+
+static uint32_t crc32c_table[256];
+
+static void crc32c_init_table(void) {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc >> 1) ^ (0x82F63B78u & (uint32_t)(-(int32_t)(crc & 1)));
+        crc32c_table[i] = crc;
+    }
+}
+
+/* crc32c(data, crc=0) -> int
+ * Streaming CRC-32C over one contiguous buffer; pass the previous
+ * return value as `crc` to accumulate across buffers (zlib.crc32
+ * calling convention). GIL released while crunching. */
+static PyObject *fastwire_crc32c(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    unsigned int crc_in = 0;
+    if (!PyArg_ParseTuple(args, "y*|I", &view, &crc_in)) return NULL;
+    uint32_t crc = crc_in ^ 0xFFFFFFFFu;
+    const unsigned char *p = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len;
+    Py_BEGIN_ALLOW_THREADS
+    for (Py_ssize_t k = 0; k < n; k++)
+        crc = crc32c_table[(crc ^ p[k]) & 0xFF] ^ (crc >> 8);
+    Py_END_ALLOW_THREADS
+    PyBuffer_Release(&view);
+    return PyLong_FromUnsignedLong(crc ^ 0xFFFFFFFFu);
+}
+
+/* ------------------------------------------------------------------ */
 /* module                                                              */
 /* ------------------------------------------------------------------ */
 
 static PyMethodDef fastwire_methods[] = {
+    {"crc32c", fastwire_crc32c, METH_VARARGS,
+     "crc32c(data, crc=0) -> int: streaming CRC-32C (Castagnoli)."},
     {"sendv", fastwire_sendv, METH_VARARGS,
      "sendv(fd, timeout_ms, buffers): fully send all buffers via writev."},
     {"recv_exact", fastwire_recv_exact, METH_VARARGS,
@@ -1524,6 +1590,8 @@ static PyMethodDef fastwire_methods[] = {
      "its dealloc releases the chunk back to the creator."},
     {"shm_ring_cancel", fastwire_shm_ring_cancel, METH_VARARGS,
      "shm_ring_cancel(ring, offset): release an undelivered chunk."},
+    {"shm_ring_chunk_state", fastwire_shm_ring_chunk_state, METH_VARARGS,
+     "shm_ring_chunk_state(ring, offset) -> 0 inflight / 1 released."},
     {"shm_ring_occupancy", fastwire_shm_ring_occupancy, METH_VARARGS,
      "shm_ring_occupancy(ring) -> (used_bytes, capacity)."},
     {"shm_ring_close", fastwire_shm_ring_close, METH_VARARGS,
@@ -1539,6 +1607,7 @@ static struct PyModuleDef fastwire_module = {
 };
 
 PyMODINIT_FUNC PyInit__fastwire(void) {
+    crc32c_init_table();
     PooledBuf_Type.tp_dealloc = PooledBuf_dealloc;
     PooledBuf_Type.tp_flags = Py_TPFLAGS_DEFAULT;
     PooledBuf_Type.tp_doc = "Pooled receive buffer (writable, buffer protocol)";

@@ -52,6 +52,12 @@ CODE_JOB_MISMATCH = 417
 # treats it as a per-push demotion signal: resend on the socket lane and
 # stop offering shm frames to this peer (proxy/lanes.py).
 CODE_SHM_UNAVAILABLE = 424
+# Frame-integrity NACK: the receiver's payload checksum (header "crc",
+# algorithm id "crca") did not match the received bytes. The sender
+# treats it as retryable — requeue the SAME frame for retransmission
+# through the resend machinery (bounded by max_attempts); never a
+# demotion signal and never a poisoned-decode crash.
+CODE_DATA_CORRUPT = 409
 CODE_INTERNAL_ERROR = 500
 
 # Seq id used by the ping_others readiness barrier for both the upstream

@@ -382,10 +382,15 @@ def init(
             )
         liveness_dict = resilience_dict.get("liveness")
         if liveness_dict is not None:
-            _liveness.start_monitor(
+            monitor = _liveness.start_monitor(
                 [p for p in addresses if p != party],
                 _liveness.LivenessConfig.from_dict(liveness_dict),
             )
+            # A DEAD peer never acks its shm descriptor frames, so its
+            # in-flight ring chunks would leak until ring close: reclaim
+            # them on the DEAD edge. Additive subscription — membership
+            # (wired below, after this block) owns the set_on_dead slot.
+            monitor.add_on_dead(barriers.cancel_peer_inflight)
 
     # Elastic membership (docs/membership.md): every founding party builds
     # the same epoch-0 view from the init addresses and installs the

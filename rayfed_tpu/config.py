@@ -244,6 +244,34 @@ class CrossSiloMessageConfig:
     # 0 disables the fast path entirely. Large-payload behavior is
     # unchanged at any setting.
     small_message_threshold: int = 64 * 1024
+    # Frame integrity (opt-in): checksum every DATA payload (crc32c via
+    # the native fastwire fast path, zlib.crc32 otherwise) in the frame
+    # header; receivers NACK mismatches with CODE_DATA_CORRUPT and the
+    # sender retransmits through the normal resend machinery — an
+    # in-flight bit flip becomes a recovered retransmit instead of a
+    # poisoned decode. CRC-less peers interoperate (header field, not a
+    # wire-version bump).
+    frame_crc: bool = False
+    # Adaptive deadlines from the per-peer LinkHealth estimator
+    # (resilience/linkhealth.py; docs/resilience.md "WAN emulation &
+    # link health"). When on: ack timeouts become
+    # clamp(rtt_timeout_multiple*srtt + 4*rttvar, min_timeout_in_ms,
+    # timeout_in_ms) plus a transfer-time allowance for the in-flight
+    # payload; recv deadlines gain RTT-multiple slack (only ever
+    # EXTENDED, never shrunk); retry backoff is ceilinged at an
+    # RTT-multiple once the link is measured. The configured
+    # timeout_in_ms stays the hard ceiling in every formula — adaptive
+    # can only tighten within [min_timeout_in_ms, timeout_in_ms].
+    adaptive_timeouts: bool = True
+    rtt_timeout_multiple: float = 8.0
+    min_timeout_in_ms: int = 1000
+    # Lane re-promotion (docs/architecture.md lane-tier table): after a
+    # shm demotion, probe the shm lane again once this many ms have
+    # passed without shm traffic, doubling the hold-off on each re-break
+    # (hysteresis, capped at 16x) so a flapping link settles on tcp
+    # instead of oscillating. 0 = legacy sticky demotion for the life of
+    # the job.
+    shm_repromote_after_ms: int = 2000
     exit_on_sending_failure: Optional[bool] = False
     expose_error_trace: Optional[bool] = False
     continue_waiting_for_data_sending_on_error: Optional[bool] = False
@@ -282,6 +310,21 @@ class CrossSiloMessageConfig:
             raise ValueError(
                 f"cross_silo_comm.shm_push_timeout_ms must be >= 0, "
                 f"got {self.shm_push_timeout_ms}"
+            )
+        if float(self.rtt_timeout_multiple) <= 0:
+            raise ValueError(
+                f"cross_silo_comm.rtt_timeout_multiple must be > 0, "
+                f"got {self.rtt_timeout_multiple}"
+            )
+        if int(self.min_timeout_in_ms) < 0:
+            raise ValueError(
+                f"cross_silo_comm.min_timeout_in_ms must be >= 0, "
+                f"got {self.min_timeout_in_ms}"
+            )
+        if int(self.shm_repromote_after_ms) < 0:
+            raise ValueError(
+                f"cross_silo_comm.shm_repromote_after_ms must be >= 0, "
+                f"got {self.shm_repromote_after_ms}"
             )
 
     def effective_max_message_bytes(self) -> Optional[int]:

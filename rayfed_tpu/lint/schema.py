@@ -32,14 +32,15 @@ from typing import Dict, FrozenSet, Optional, Tuple
 
 #: dataclass-mirrored field sets (pinned by the runtime test).
 CROSS_SILO_BASE_FIELDS = frozenset({
-    "allow_pickle_payloads", "compression_level",
+    "adaptive_timeouts", "allow_pickle_payloads", "compression_level",
     "continue_waiting_for_data_sending_on_error", "device_dma",
     "dma_listen_addr", "exit_on_sending_failure", "expose_error_trace",
-    "lane_tiers", "messages_max_size_in_bytes", "payload_compression",
-    "payload_wire_dtype", "recv_timeout_in_ms", "same_mesh_push",
+    "frame_crc", "lane_tiers", "messages_max_size_in_bytes",
+    "min_timeout_in_ms", "payload_compression", "payload_wire_dtype",
+    "recv_timeout_in_ms", "rtt_timeout_multiple", "same_mesh_push",
     "send_deadline_in_ms", "serializing_allowed_list", "shm_enabled",
-    "shm_min_bytes", "shm_push_timeout_ms", "shm_ring_mb",
-    "small_message_threshold", "timeout_in_ms",
+    "shm_min_bytes", "shm_push_timeout_ms", "shm_repromote_after_ms",
+    "shm_ring_mb", "small_message_threshold", "timeout_in_ms",
 })
 
 TCP_CROSS_SILO_FIELDS = CROSS_SILO_BASE_FIELDS | frozenset({

@@ -179,6 +179,41 @@ def forget_peer(party: str) -> None:
                 )
 
 
+def cancel_peer_inflight(party: str) -> int:
+    """Reclaim shm ring chunks still in flight to ``party`` (fired on
+    the liveness monitor's DEAD edge). A dead peer never acks the
+    descriptor frames for chunks already written into its ring, so
+    without this every INFLIGHT chunk it holds leaks until ring close —
+    shrinking the ring for any same-host peer that adopts it after a
+    restart. Reaches the transport's per-destination shm sender through
+    the same getattr delegation ``forget_peer`` uses (the injector
+    wrapper delegates attribute access); transports without per-dest
+    workers or an shm lane are a no-op. Returns chunks reclaimed."""
+    if _sender_proxy is None:
+        return 0
+    workers = getattr(_sender_proxy, "_workers", None)
+    if not isinstance(workers, dict):
+        return 0
+    worker = workers.get(party)
+    shm = getattr(worker, "_shm", None) if worker is not None else None
+    if shm is None:
+        return 0
+    try:
+        n = shm.cancel_peer_inflight()
+    except Exception:  # noqa: BLE001 - reclamation is best-effort
+        logger.warning(
+            "failed to reclaim in-flight shm chunks for DEAD party %s",
+            party, exc_info=True,
+        )
+        return 0
+    if n:
+        logger.info(
+            "reclaimed %d in-flight shm chunk(s) held by DEAD party %s",
+            n, party,
+        )
+    return n
+
+
 def swap_sender_proxy(new_proxy) -> None:
     """Replace the current sender proxy in place — the seam the fault
     injector (resilience/inject.py) wraps and unwraps through. Registry

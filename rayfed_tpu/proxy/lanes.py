@@ -30,8 +30,11 @@ frames, acks and the resend/peer-down machinery ride the underlying
 reactor lane unchanged — so every shm failure demotes gracefully:
 ring-full or create-failure falls back per push, and a receiver-side
 attach/adopt failure NACKs with code 424, which resends that push on
-the socket lane and stops offering shm frames to the peer (sticky
-demotion).
+the socket lane and stops offering shm frames to the peer. The
+demotion heals: after ``shm_repromote_after_ms`` (exponential hold-off
+on repeat breaks) one push probes the ring again and a descriptor ACK
+re-promotes the peer — see :class:`ShmSender`. 0 keeps the legacy
+sticky demotion.
 
 The same-host shm data plane lives here too: :class:`ShmSender` (ring
 ownership + push/fallback bookkeeping for one destination) and
@@ -350,8 +353,21 @@ def record_lane_send(lane: str) -> None:
     _lane_counter().labels(lane=lane).inc()
 
 
+def _repromotion_counter():
+    return telemetry_metrics.get_registry().counter(
+        "fed_transport_lane_repromotions_total",
+        "Successful lane re-promotions after a demotion (health probe "
+        "ACKed), by the lane promoted back to.",
+        labels=("lane",),
+    )
+
+
 def record_fallback(lane: str, to: str) -> None:
     _fallback_counter().labels(lane=lane, to=to).inc()
+
+
+def record_repromotion(lane: str) -> None:
+    _repromotion_counter().labels(lane=lane).inc()
 
 
 def set_peer_tier(peer: str, tier: str) -> None:
@@ -540,6 +556,17 @@ class _PyShmRing:
         sanitize.probe_shm_cancel(state, _ST_INFLIGHT, off)
         self._set_state(pos, _ST_RELEASED)
 
+    def chunk_state(self, off: int) -> Optional[int]:
+        """State word of the chunk at ``off`` (_ST_INFLIGHT/_ST_RELEASED)
+        or None when the offset names no live chunk."""
+        if self.closed:
+            return None
+        pos = off - _CHUNK_HDR
+        if pos < 0 or pos % _ALIGN or pos >= self.cap:
+            return None
+        magic, state, _size = self._chunk(pos)
+        return state if magic == _CHUNK_MAGIC else None
+
     def occupancy(self) -> Tuple[int, int]:
         if self.creator:
             self._reclaim()
@@ -612,6 +639,14 @@ class _NativeShmRing:
     def cancel(self, off: int) -> None:
         _fw.shm_ring_cancel(self._ring, off)
 
+    def chunk_state(self, off: int) -> Optional[int]:
+        if not hasattr(_fw, "shm_ring_chunk_state"):
+            return None  # older native build: caller cancels blindly
+        try:
+            return _fw.shm_ring_chunk_state(self._ring, off)
+        except Exception:  # noqa: BLE001 - bad offset/closed ring
+            return None
+
     def occupancy(self) -> Tuple[int, int]:
         return _fw.shm_ring_occupancy(self._ring)
 
@@ -668,8 +703,25 @@ class ShmSender:
     ring is single-producer, so pushes serialize on a lock (submitters
     may run on arbitrary threads in reactor mode). Every failure path
     returns None — the caller falls back to the socket lane and the
-    send can never be lost. ``mark_broken`` makes the demotion sticky
-    after a receiver-side 424."""
+    send can never be lost.
+
+    Demotion and re-promotion: ``mark_broken`` (receiver NACK 424 or a
+    local ring failure) demotes the peer to the socket lane. With
+    ``shm_repromote_after_ms`` == 0 that is sticky for the life of the
+    job (the pre-PR-17 behavior). Otherwise the sender re-probes the
+    ring after an exponential hold-off — base x 2^(demotions-1), capped
+    at 16x — by letting exactly ONE push through (``eligible`` opens the
+    probe); the ack outcome decides: descriptor ACK => ``mark_recovered``
+    (the caller records the re-promotion), another 424 => re-demoted
+    with a doubled hold-off. The demotion count is never reset, so a
+    flapping link backs off harder each cycle instead of oscillating.
+
+    In-flight accounting (the peer-death leak fix): every pushed offset
+    stays in ``_outstanding`` until its descriptor frame is ACKed
+    (``on_delivered``) or cancelled; ``cancel_peer_inflight`` reclaims
+    every still-INFLIGHT outstanding chunk when liveness declares the
+    peer DEAD — without it, chunks pinned for a receiver that died
+    before adopting are leaked for the life of the ring."""
 
     def __init__(self, job: str, src: str, dest: str, cfg):
         self._cap = max(1, int(getattr(cfg, "shm_ring_mb", 256) or 256)) << 20
@@ -678,27 +730,63 @@ class ShmSender:
             max(0, int(getattr(cfg, "shm_push_timeout_ms", 250) or 0))
             / 1000.0
         )
+        self._repromote_base_s = (
+            max(0, int(getattr(cfg, "shm_repromote_after_ms", 0) or 0))
+            / 1000.0
+        )
         self._name = ring_name(job, src, dest)
         self._dest = dest
         self._ring = None
         self._broken = False
+        self._demotions = 0
+        self._retry_at: Optional[float] = None
+        self._probing = False
+        self._outstanding: set = set()
         self._lock = threading.Lock()
 
     @property
     def broken(self) -> bool:
         return self._broken
 
+    @property
+    def probing(self) -> bool:
+        return self._probing
+
+    @property
+    def demotions(self) -> int:
+        return self._demotions
+
+    def outstanding_count(self) -> int:
+        with self._lock:
+            return len(self._outstanding)
+
     def eligible(self, header: Dict, payload_len: int) -> bool:
         """May this frame ride the ring? Errors stay on the ordered
         socket lane; sub-threshold frames aren't worth a descriptor
-        round-trip; a payload bigger than the whole ring can never fit."""
-        return (
-            not self._broken
-            and not header.get("is_error")
-            and header.get("pkind") in _SHM_KINDS
-            and payload_len >= self._min
-            and payload_len + 2 * _CHUNK_HDR <= self._cap
-        )
+        round-trip; a payload bigger than the whole ring can never fit.
+        On a demoted peer this is also the re-promotion gate: once the
+        hold-off expires, exactly one push is let through as the health
+        probe."""
+        if (
+            header.get("is_error")
+            or header.get("pkind") not in _SHM_KINDS
+            or payload_len < self._min
+            or payload_len + 2 * _CHUNK_HDR > self._cap
+        ):
+            return False
+        if not self._broken:
+            return True
+        if self._repromote_base_s <= 0:
+            return False  # legacy sticky demotion
+        with self._lock:
+            if not self._broken:
+                return True
+            if self._probing:
+                return False  # one probe in flight at a time
+            if self._retry_at is None or time.monotonic() < self._retry_at:
+                return False
+            self._probing = True
+            return True
 
     def push(self, buffers, payload_len: int) -> Optional[Tuple[str, int]]:
         """Copy the frame's buffers into the ring. Returns (ring_name,
@@ -706,7 +794,7 @@ class ShmSender:
         to shm_push_timeout_ms for receivers to release space — the ring
         throttles, the socket lane is the pressure valve."""
         with self._lock:
-            if self._broken:
+            if self._broken and not self._probing:
                 return None
             if self._ring is None:
                 try:
@@ -716,7 +804,7 @@ class ShmSender:
                         "shm ring create for %s failed (%s); peer demoted "
                         "to the socket lane", self._dest, e,
                     )
-                    self._broken = True
+                    self._mark_broken_locked()
                     return None
             deadline = time.monotonic() + self._timeout_s
             while True:
@@ -729,6 +817,7 @@ class ShmSender:
                     )
                     return None
                 if off is not None:
+                    self._outstanding.add(off)
                     try:
                         used, _cap = self._ring.occupancy()
                         _ring_occupancy_gauge().set(float(used))
@@ -742,14 +831,84 @@ class ShmSender:
     def cancel(self, off: int) -> None:
         """Release a pushed chunk whose descriptor was never delivered."""
         with self._lock:
+            self._outstanding.discard(off)
             if self._ring is not None:
                 try:
                     self._ring.cancel(off)
                 except Exception:  # noqa: BLE001 - space leak bounded by ring
                     logger.debug("shm cancel failed", exc_info=True)
 
-    def mark_broken(self) -> None:
+    def on_delivered(self, off: int) -> None:
+        """The descriptor frame was ACKed: chunk ownership is with the
+        receiver now (its adopt/release governs the lifetime)."""
+        with self._lock:
+            self._outstanding.discard(off)
+
+    def cancel_peer_inflight(self) -> int:
+        """Reclaim every outstanding chunk that is still INFLIGHT —
+        called when liveness declares the peer DEAD. Chunks the receiver
+        already released (adopted-then-died, or the py-ring's
+        copy-on-adopt) are skipped: cancelling those again would be a
+        double release. Returns the number of chunks reclaimed."""
+        with self._lock:
+            if self._ring is None:
+                self._outstanding.clear()
+                return 0
+            reclaimed = 0
+            for off in list(self._outstanding):
+                self._outstanding.discard(off)
+                state = None
+                chunk_state = getattr(self._ring, "chunk_state", None)
+                if chunk_state is not None:
+                    state = chunk_state(off)
+                if state is not None and state != _ST_INFLIGHT:
+                    continue
+                try:
+                    self._ring.cancel(off)
+                    reclaimed += 1
+                except Exception:  # noqa: BLE001 - already-dead chunk
+                    logger.debug(
+                        "shm peer-death cancel failed", exc_info=True
+                    )
+            try:
+                used, _cap = self._ring.occupancy()
+                _ring_occupancy_gauge().set(float(used))
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
+            if reclaimed:
+                logger.info(
+                    "reclaimed %d in-flight shm chunk(s) for dead peer %s",
+                    reclaimed, self._dest,
+                )
+            return reclaimed
+
+    def _mark_broken_locked(self) -> None:
+        self._probing = False
         self._broken = True
+        self._demotions += 1
+        if self._repromote_base_s > 0:
+            holdoff = self._repromote_base_s * min(
+                2.0 ** (self._demotions - 1), 16.0
+            )
+            self._retry_at = time.monotonic() + holdoff
+
+    def mark_broken(self) -> None:
+        with self._lock:
+            self._mark_broken_locked()
+
+    def mark_recovered(self) -> bool:
+        """A probe push was descriptor-ACKed: the peer adopts shm frames
+        again. Returns True when this actually transitioned the sender
+        out of the demoted state (the caller's cue to record the
+        re-promotion). The demotion count is deliberately kept — the
+        hysteresis memory that makes a flapping link back off harder
+        each cycle."""
+        with self._lock:
+            was_broken = self._broken
+            self._broken = False
+            self._probing = False
+            self._retry_at = None
+            return was_broken
 
     def close(self) -> None:
         with self._lock:
@@ -757,6 +916,8 @@ class ShmSender:
                 self._ring.close()
                 self._ring = None
             self._broken = True
+            self._probing = False
+            self._outstanding.clear()
 
 
 def encode_shm_descriptor(name: str, off: int, length: int,
@@ -795,6 +956,27 @@ class ShmAdopter:
         self._offer = offer
         self._rings: "OrderedDict[str, object]" = OrderedDict()
         self._lock = threading.Lock()
+        # Adoptions already failed under FEDTPU_SHM_FORCE_ATTACH_FAIL=<N>.
+        self._forced_failed = 0
+
+    def _forced_attach_fail(self) -> bool:
+        """Test hook: ``FEDTPU_SHM_FORCE_ATTACH_FAIL=<N>`` fails the
+        next N shm adoptions, then succeeds — the knob the
+        demotion→re-promotion chaos tests turn (fail enough adoptions to
+        demote the lane, then let the sender's health probe land). A
+        non-integer truthy value fails every adoption while set."""
+        raw = os.environ.get("FEDTPU_SHM_FORCE_ATTACH_FAIL")
+        if not raw:
+            return False
+        try:
+            n = int(raw)
+        except ValueError:
+            return True
+        with self._lock:
+            if self._forced_failed < n:
+                self._forced_failed += 1
+                return True
+        return False
 
     def _get_ring(self, name: str):
         with self._lock:
@@ -833,7 +1015,7 @@ class ShmAdopter:
     def offer(self, header: Dict, payload) -> Tuple[int, str]:
         if header.get("pkind") != "shm":
             return self._offer(header, payload)
-        if os.environ.get("FEDTPU_SHM_FORCE_ATTACH_FAIL"):
+        if self._forced_attach_fail():
             return (
                 CODE_SHM_UNAVAILABLE,
                 "forced attach failure (FEDTPU_SHM_FORCE_ATTACH_FAIL)",

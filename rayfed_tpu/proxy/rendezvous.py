@@ -351,8 +351,17 @@ class RendezvousStore:
             "fed_transport_ghost_evicted_total",
             "Parked frames purged because their source party was evicted.",
         )
+        self._m_dup = _reg.counter(
+            "fed_transport_duplicate_offers_total",
+            "Duplicate frames dropped by the consumed-key done-ring "
+            "(ack-lost or ack-late resends).",
+        )
         self._stats_lock = threading.Lock()
-        self._stats = {"receive_op_count": 0, "ghost_evicted": 0}
+        self._stats = {
+            "receive_op_count": 0,
+            "ghost_evicted": 0,
+            "duplicate_offers": 0,
+        }
         # Readiness-ping bookkeeping (barrier mutuality): which peers
         # have pinged this receiver, by the header's src when the lane
         # carries one; pings on the reference-compatible gRPC wire have
@@ -551,8 +560,14 @@ class RendezvousStore:
         self._bump_recv()
         with self._lock:
             if key in self._consumed:
-                # Duplicate of an already-delivered frame (ack-lost resend):
-                # acknowledge and drop. Not traced — it carried no new data.
+                # Duplicate of an already-delivered frame (ack-lost or
+                # ack-late resend): acknowledge and drop. Not traced — it
+                # carried no new data. Counted, though: the delay-fault ×
+                # ack-timeout chaos tests assert duplicates stay BOUNDED
+                # (each resend attempt produces at most one dedup hit).
+                with self._stats_lock:
+                    self._stats["duplicate_offers"] += 1
+                self._m_dup.inc()
                 return CODE_OK, "duplicate"
             waiter = self._waiters.pop(key, None)
             self._deadlines.pop(key, None)
@@ -609,11 +624,28 @@ class RendezvousStore:
                     import time
 
                     self._deadlines[key] = (
-                        time.monotonic() + self._recv_timeout_s
+                        time.monotonic()
+                        + self._recv_timeout_s
+                        + self._recv_slack_s()
                     )
                 return out
         self._deliver(header, payload, out)
         return out
+
+    def _recv_slack_s(self) -> float:
+        """Adaptive extension for a freshly-parked recv deadline: the
+        worst measured link slack across all peers (``take`` cannot know
+        which peer will complete the key, so it budgets for the slowest).
+        Only ever EXTENDS the configured ``recv_timeout_in_ms`` — zero
+        until link health has samples — and is capped at one extra
+        budget, so a pathological estimate at most doubles the wait."""
+        try:
+            from rayfed_tpu.resilience import linkhealth
+
+            slack = linkhealth.get_health().max_recv_slack_s()
+        except Exception:  # noqa: BLE001 - slack is best-effort
+            return 0.0
+        return min(slack, self._recv_timeout_s)
 
     def _decode_into(self, header: Dict, payload, out: Future) -> None:
         try:

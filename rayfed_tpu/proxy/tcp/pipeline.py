@@ -39,10 +39,20 @@ from concurrent.futures import Future
 from queue import Empty, Queue
 from typing import Callable, Optional
 
-from rayfed_tpu._private.constants import CODE_OK
+from rayfed_tpu._private.constants import CODE_DATA_CORRUPT, CODE_OK
 from rayfed_tpu.proxy.tcp import sockio, wire
+from rayfed_tpu.resilience import inject as fault_inject
+from rayfed_tpu.resilience import linkhealth
+from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
+
+# Shared by both lane engines (reactor.py imports it from here): frames
+# retransmitted after a peer frame-integrity NACK (docs/observability.md).
+_m_crc_resends = telemetry_metrics.get_registry().counter(
+    "fed_transport_frame_crc_retransmits_total",
+    "Frames retransmitted after a peer crc NACK (CODE_DATA_CORRUPT).",
+)
 
 # Default max unacknowledged frames in flight (config knob: send_window).
 # Payload buffers stay referenced until acked, so the window bounds resend
@@ -87,11 +97,15 @@ class PipelinedLane:
         on_ack: Callable[[], None],
         window: int = WINDOW,
         small_threshold: int = 0,
+        adaptive_timeout=None,
     ):
         self._dest = dest
         self._connect = connect
         self._max_attempts = max_attempts
         self._ack_timeout_s = ack_timeout_s
+        # Optional (base_s, nbytes) -> timeout_s hook from the link-health
+        # estimator — same contract as ReactorLane (resilience/linkhealth.py).
+        self._adaptive_timeout = adaptive_timeout
         self._on_ack = on_ack
         # Frames at/below this payload size may be coalesced with other
         # queued frames into one vectored write (0 disables batching).
@@ -140,6 +154,21 @@ class PipelinedLane:
             return
         self._jobs.put(job)
 
+    def _wire_frame(self, job: _Inflight):
+        """(ftype, header, buffers) for one transmission of ``job``. A
+        registered wire taint (chaos ``corrupt`` fault with frame_crc on)
+        flips one bit in a COPY of the affected buffer for THIS
+        transmission only — ``job.buffers`` stays clean, so the crc-NACK
+        retransmit carries the original bytes (resilience/inject.py)."""
+        buffers = job.buffers
+        up, down = job.header.get("up"), job.header.get("down")
+        taint = fault_inject.take_wire_taint(self._dest, up, down)
+        if taint is not None:
+            buffers = fault_inject.corrupt_wire_buffers(
+                buffers, self._dest, up, down, taint
+            )
+        return (wire.FTYPE_DATA, job.header, buffers)
+
     def _try_inline_send(self, job: _Inflight) -> bool:
         """Zero-hop dispatch: when the lane is idle — live connection,
         free window slot, no queued backlog, write mutex uncontended —
@@ -171,9 +200,7 @@ class PipelinedLane:
                 self._window.release()
                 return False
             try:
-                sockio.send_frames(
-                    sock, [(wire.FTYPE_DATA, job.header, job.buffers)]
-                )
+                sockio.send_frames(sock, [self._wire_frame(job)])
             except (OSError, ConnectionError) as e:
                 # The job is tracked in _inflight: the break machinery
                 # owns it now (resend from _tick, or attempt-budget fail).
@@ -276,9 +303,7 @@ class PipelinedLane:
             try:
                 with self._send_mutex:
                     sockio.send_frames(
-                        sock,
-                        [(wire.FTYPE_DATA, j.header, j.buffers)
-                         for j in jobs],
+                        sock, [self._wire_frame(j) for j in jobs]
                     )
                 return True
             except (OSError, ConnectionError) as e:
@@ -340,9 +365,7 @@ class PipelinedLane:
                     job.sent_at = now
                 with self._send_mutex:
                     sockio.send_frames(
-                        sock,
-                        [(wire.FTYPE_DATA, j.header, j.buffers)
-                         for j in pending],
+                        sock, [self._wire_frame(j) for j in pending]
                     )
                 return True
             except (OSError, ConnectionError) as e:
@@ -372,16 +395,22 @@ class PipelinedLane:
         """Idle housekeeping: ack timeouts and broken-connection resends."""
         now = time.monotonic()
         expired = None
+        timeout_s = self._ack_timeout_s
         with self._lock:
             if self._inflight:
                 head = self._inflight[0]
-                if now - head.sent_at > self._ack_timeout_s:
+                if self._adaptive_timeout is not None:
+                    timeout_s = self._adaptive_timeout(
+                        self._ack_timeout_s, head.nbytes
+                    )
+                if now - head.sent_at > timeout_s:
                     expired = self._inflight.popleft()
         if expired is not None:
+            linkhealth.observe_loss(self._dest)
             self._window.release()
             expired.out.set_exception(
                 TimeoutError(
-                    f"no ack from {self._dest} within {self._ack_timeout_s}s"
+                    f"no ack from {self._dest} within {timeout_s:.3f}s"
                 )
             )
             self._handle_break(ConnectionError("ack timeout"))
@@ -428,8 +457,28 @@ class PipelinedLane:
                 self._window.release()
                 code = resp.get("code")
                 if code == CODE_OK:
+                    # Ack round-trip feeds the adaptive-deadline estimate
+                    # (resilience/linkhealth.py).
+                    linkhealth.observe_rtt(
+                        self._dest, time.monotonic() - job.sent_at
+                    )
                     self._on_ack()
                     job.out.set_result(True)
+                elif (
+                    code == CODE_DATA_CORRUPT
+                    and job.attempts < self._max_attempts
+                ):
+                    # Frame-integrity NACK: our stored buffers are clean
+                    # (the crc was stamped over them) — requeue for a
+                    # retransmit, bounded by the same attempt budget as
+                    # reconnect resends.
+                    _m_crc_resends.inc()
+                    logger.warning(
+                        "peer %s NACKed frame fseq=%s as corrupt; "
+                        "retransmitting (attempt %d/%d)",
+                        self._dest, fseq, job.attempts, self._max_attempts,
+                    )
+                    self._jobs.put(job)
                 else:
                     logger.warning(
                         "peer rejected send: code=%s message=%s",

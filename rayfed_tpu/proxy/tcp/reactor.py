@@ -65,7 +65,9 @@ import msgpack
 
 from rayfed_tpu import sanitize
 from rayfed_tpu.proxy.tcp import sockio, wire
-from rayfed_tpu.proxy.tcp.pipeline import _Inflight
+from rayfed_tpu.proxy.tcp.pipeline import _Inflight, _m_crc_resends
+from rayfed_tpu.resilience import inject as fault_inject
+from rayfed_tpu.resilience import linkhealth
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
@@ -643,11 +645,17 @@ class ReactorLane:
         window: int = 8,
         small_threshold: int = 0,
         reactor: Optional[Reactor] = None,
+        adaptive_timeout=None,
     ):
         self._dest = dest
         self._connect = connect
         self._max_attempts = max_attempts
         self._ack_timeout_s = ack_timeout_s
+        # Optional (base_s, nbytes) -> timeout_s hook: link-health RTT
+        # estimate plus a transfer-time allowance for the frame size, so
+        # a slow WAN shrinks the ack deadline no further than the bytes
+        # in flight can actually clear it (resilience/linkhealth.py).
+        self._adaptive_timeout = adaptive_timeout
         self._on_ack = on_ack
         self._small_threshold = small_threshold
         self._reactor = reactor or acquire_reactors(1)[0]
@@ -721,7 +729,7 @@ class ReactorLane:
             return False
         if sanitize.enabled():
             sanitize.probe_inline_busy_set(id(self))
-        chunks = _frame_chunks(job.header, job.buffers)
+        chunks = self._wire_chunks(job)
         total = sum(c.nbytes if isinstance(c, memoryview) else len(c)
                     for c in chunks)
         n = _nb_writev(fd, chunks)
@@ -799,6 +807,21 @@ class ReactorLane:
 
     # -- reactor-thread machinery --------------------------------------------
 
+    def _wire_chunks(self, job: _Inflight) -> List:
+        """Wire chunks for one transmission of ``job``. A registered
+        wire taint (chaos ``corrupt`` fault with frame_crc on) flips one
+        bit in a COPY of the affected buffer for THIS transmission only —
+        ``job.buffers`` stays clean, so the crc-NACK retransmit carries
+        the original bytes (resilience/inject.py)."""
+        buffers = job.buffers
+        up, down = job.header.get("up"), job.header.get("down")
+        taint = fault_inject.take_wire_taint(self._dest, up, down)
+        if taint is not None:
+            buffers = fault_inject.corrupt_wire_buffers(
+                buffers, self._dest, up, down, taint
+            )
+        return _frame_chunks(job.header, buffers)
+
     def _pump(self) -> None:
         """Move pending jobs into the ring as window slots allow; dial if
         the connection is down. Loop thread only."""
@@ -835,7 +858,7 @@ class ReactorLane:
                 job.attempts += 1
                 job.sent_at = time.monotonic()
                 self._inflight.append(job)
-                self._outbox.extend(_frame_chunks(job.header, job.buffers))
+                self._outbox.extend(self._wire_chunks(job))
                 moved = True
         if moved or self._outbox:
             self._reactor.mark_dirty(self)
@@ -896,9 +919,10 @@ class ReactorLane:
                 self._on_break(e)
 
     def _handle_ack(self, resp: Dict) -> None:
-        from rayfed_tpu._private.constants import CODE_OK
+        from rayfed_tpu._private.constants import CODE_DATA_CORRUPT, CODE_OK
 
         fseq = resp.get("fseq")
+        now = time.monotonic()
         with self._lock:
             job = None
             for candidate in self._inflight:
@@ -917,8 +941,28 @@ class ReactorLane:
             self._pump()
         code = resp.get("code")
         if code == CODE_OK:
+            # Ack round-trip = wire latency + receiver offer; both belong
+            # in the adaptive-deadline estimate (resilience/linkhealth.py).
+            linkhealth.observe_rtt(self._dest, now - job.sent_at)
             self._on_ack()
             job.out.set_result(True)
+        elif code == CODE_DATA_CORRUPT and job.attempts < self._max_attempts:
+            # Frame-integrity NACK: the bytes we hold are fine (the crc
+            # was stamped over them), the wire mangled the frame. Requeue
+            # at the head — the stored buffers retransmit clean, bounded
+            # by the same attempt budget as reconnect resends.
+            _m_crc_resends.inc()
+            logger.warning(
+                "peer %s NACKed frame fseq=%s as corrupt; retransmitting "
+                "(attempt %d/%d)",
+                self._dest, fseq, job.attempts, self._max_attempts,
+            )
+            with self._lock:
+                if self._closed:
+                    job.out.set_exception(ConnectionError("sender stopped"))
+                    return
+                self._pending.appendleft(job)
+            self._pump()
         else:
             logger.warning(
                 "peer rejected send: code=%s message=%s",
@@ -935,21 +979,24 @@ class ReactorLane:
     def _tick(self, now: float) -> None:
         """Ack timeouts + broken-lane redials (reactor tick cadence)."""
         expired = None
+        timeout_s = self._ack_timeout_s
         with self._lock:
             if self._closed:
                 return
-            if (
-                self._inflight
-                and not self._broken
-                and not self._dialing
-                and now - self._inflight[0].sent_at > self._ack_timeout_s
-            ):
-                expired = self._inflight.popleft()
+            if self._inflight and not self._broken and not self._dialing:
+                head = self._inflight[0]
+                if self._adaptive_timeout is not None:
+                    timeout_s = self._adaptive_timeout(
+                        self._ack_timeout_s, head.nbytes
+                    )
+                if now - head.sent_at > timeout_s:
+                    expired = self._inflight.popleft()
         if expired is not None:
+            linkhealth.observe_loss(self._dest)
             self._window.release()
             expired.out.set_exception(
                 TimeoutError(
-                    f"no ack from {self._dest} within {self._ack_timeout_s}s"
+                    f"no ack from {self._dest} within {timeout_s:.3f}s"
                 )
             )
             self._on_break(ConnectionError("ack timeout"))
@@ -1037,9 +1084,7 @@ class ReactorLane:
                 for job in self._inflight:
                     job.attempts += 1
                     job.sent_at = now
-                    self._outbox.extend(
-                        _frame_chunks(job.header, job.buffers)
-                    )
+                    self._outbox.extend(self._wire_chunks(job))
         if closed:
             try:
                 sock.close()

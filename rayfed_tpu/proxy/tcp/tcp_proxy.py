@@ -52,9 +52,10 @@ from concurrent.futures import Future, InvalidStateError
 from queue import Empty, Queue
 from typing import Dict, Optional, Tuple
 
-from rayfed_tpu import tracing
+from rayfed_tpu import sanitize, tracing
 from rayfed_tpu._private import executor, serialization
 from rayfed_tpu._private.constants import (
+    CODE_DATA_CORRUPT,
     CODE_FORBIDDEN,
     CODE_INTERNAL_ERROR,
     CODE_OK,
@@ -69,12 +70,23 @@ from rayfed_tpu.proxy.base import (
     SenderReceiverProxy,
 )
 from rayfed_tpu.proxy.rendezvous import RendezvousStore
+from rayfed_tpu.proxy.tcp import checksum
 from rayfed_tpu.proxy.tcp import reactor as reactor_mod
 from rayfed_tpu.proxy.tcp import sockio, wire
+from rayfed_tpu.proxy.tcp.pipeline import _m_crc_resends
+from rayfed_tpu.resilience import inject as fault_inject
+from rayfed_tpu.resilience import linkhealth
 from rayfed_tpu.resilience.retry import Deadline, run_with_retry
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
+
+# Received DATA frames whose payload failed crc verification (NACKed
+# with CODE_DATA_CORRUPT for retransmit — docs/observability.md).
+_m_crc_failures = telemetry_metrics.get_registry().counter(
+    "fed_transport_frame_crc_failures_total",
+    "Received frames failing crc verification.",
+)
 
 
 def _reactor_mode(cfg, tls_config) -> bool:
@@ -137,6 +149,8 @@ class _DestWorker(threading.Thread):
             self._shm = lanes.ShmSender(
                 proxy._job_name, proxy._party, dest_party, self._cfg
             )
+        self._frame_crc = bool(getattr(self._cfg, "frame_crc", False))
+        self._adaptive = bool(getattr(self._cfg, "adaptive_timeouts", False))
         use_reactor = _reactor_mode(self._cfg, proxy._tls_config)
         if not wire.tls_enabled(proxy._tls_config):
             # Plaintext connections pipeline frames (window of unacked
@@ -154,6 +168,9 @@ class _DestWorker(threading.Thread):
                 on_ack=bump_acks,
                 window=self._cfg.send_window,
                 small_threshold=self._small_threshold,
+                adaptive_timeout=(
+                    self._adaptive_ack_timeout if self._adaptive else None
+                ),
             )
             if use_reactor:
                 # K parallel lanes for shard striping; lane 0 carries all
@@ -184,6 +201,36 @@ class _DestWorker(threading.Thread):
         )
         if self._threaded:
             self.start()
+
+    # Conservative wire-rate floor for the per-frame transfer allowance:
+    # the adaptive ack deadline is learned from (mostly small) ack
+    # round-trips, so a bulk frame gets extra time proportional to its
+    # size or a 100MB push on a 100Mbit link would be declared lost
+    # while its bytes are still clearing the pipe.
+    _MIN_WIRE_BITS_PER_S = 50e6
+
+    def _adaptive_ack_timeout(self, base_s: float, nbytes: int) -> float:
+        """Lane hook: link-health ack deadline for this peer plus the
+        frame's transfer-time allowance (resilience/linkhealth.py). The
+        configured ``timeout_in_ms`` stays the hard ceiling on the
+        health-derived part; with no RTT samples yet it returns the base
+        unchanged."""
+        t = linkhealth.get_health().ack_timeout_s(
+            self._dest,
+            base_s,
+            mult=self._cfg.rtt_timeout_multiple,
+            floor_s=self._cfg.min_timeout_in_ms / 1000,
+        )
+        return t + nbytes * 8.0 / self._MIN_WIRE_BITS_PER_S
+
+    def _stamp_crc(self, header: Dict, buffers) -> None:
+        """Stamp the frame-integrity checksum over the FINAL wire bytes
+        of this frame (post-serialization, post-compression; for shm/
+        stripe frames: the descriptor / stripe slice actually sent).
+        Stamped at the last point before lane submit so every frame
+        shape checks the bytes it really carries."""
+        if self._frame_crc:
+            header["crc"], header["crca"] = checksum.compute(buffers)
 
     def submit(self, job) -> None:
         if self._threaded:
@@ -275,6 +322,7 @@ class _DestWorker(threading.Thread):
                 h["pk"] = header["pkind"]
             else:
                 h["pmeta"] = b""
+            self._stamp_crc(h, bufs)
             part: Future = Future()
             part.add_done_callback(_on_part)
             self._lanes[i % len(self._lanes)].submit(part, h, bufs, nbytes)
@@ -285,6 +333,7 @@ class _DestWorker(threading.Thread):
         ordered lane 0 otherwise."""
         if self._try_submit_striped(out, header, buffers, payload_len):
             return
+        self._stamp_crc(header, buffers)
         self._lane.submit(out, header, buffers, payload_len)
 
     def _try_submit_shm(self, out, header, buffers, payload_len) -> bool:
@@ -310,12 +359,25 @@ class _DestWorker(threading.Thread):
         dheader = dict(header)
         dheader["pkind"] = "shm"
         dheader["pmeta"] = b""
+        # The descriptor IS this frame's wire payload: the crc covers it,
+        # not the ring bytes (same-host memory is not the WAN's problem).
+        self._stamp_crc(dheader, [desc])
+        was_probe = shm.probing
 
         inner: Future = Future()
 
         def _on_desc(f: Future) -> None:
             err = f.exception()
             if err is None and f.result() is True:
+                shm.on_delivered(off)
+                if was_probe and shm.mark_recovered():
+                    lanes.set_peer_tier(self._dest, "shm")
+                    lanes.record_repromotion("shm")
+                    logger.info(
+                        "peer %s adopted the shm probe frame; re-promoted "
+                        "to the shm lane (demotion count %d)",
+                        self._dest, shm.demotions,
+                    )
                 lanes.record_lane_send("shm")
                 try:
                     out.set_result(True)
@@ -333,6 +395,11 @@ class _DestWorker(threading.Thread):
                     "the socket lane for the rest of the job",
                     self._dest, err,
                 )
+            elif was_probe:
+                # Probe inconclusive (socket failure, not a 424): close
+                # the probe window and re-arm the hold-off — leaving
+                # _probing set would admit unbounded pushes while broken.
+                shm.mark_broken()
             lanes.record_fallback("shm", "tcp")
             try:
                 self._submit_socket(out, header, buffers, payload_len)
@@ -464,7 +531,7 @@ class _DestWorker(threading.Thread):
                     out, header, buffers, payload_len
                 ):
                     continue
-                self._lane.submit(out, header, buffers, payload_len)
+                self._submit_socket(out, header, buffers, payload_len)
                 continue
             try:
                 out.set_result(self._send_half_duplex(header, buffers))
@@ -568,7 +635,7 @@ class _DestWorker(threading.Thread):
         self._attach_done_callbacks(
             out, on_done, payload_len, upstream_seq_id, downstream_seq_id
         )
-        self._lane.submit(out, header, buffers, payload_len)
+        self._submit_socket(out, header, buffers, payload_len)
         return True
 
     def _prepare(self, data, upstream_seq_id, downstream_seq_id,
@@ -655,6 +722,15 @@ class _DestWorker(threading.Thread):
         cfg = self._cfg
         policy = cfg.get_retry_policy()
         deadline = Deadline.from_ms(cfg.send_deadline_in_ms)
+        self._stamp_crc(header, buffers)
+        # Adaptive backoff ceiling: on a link whose RTT we know, there is
+        # no point sleeping seconds between retries of a millisecond
+        # round-trip; the policy cap stands for never-measured peers.
+        backoff_ceiling = None
+        if self._adaptive:
+            backoff_ceiling = linkhealth.get_health().backoff_ceiling_s(
+                self._dest, policy.max_backoff_ms / 1000
+            )
 
         def attempt_stream(attempt: int):
             try:
@@ -666,11 +742,23 @@ class _DestWorker(threading.Thread):
                 # The dial already exhausted its own retry budget —
                 # re-dialing per stream attempt would square it.
                 raise _ConnectExhausted() from e
+            wire_bufs = buffers
+            taint = fault_inject.take_wire_taint(
+                self._dest, header.get("up"), header.get("down")
+            )
+            if taint is not None:
+                wire_bufs = fault_inject.corrupt_wire_buffers(
+                    buffers, self._dest, header.get("up"),
+                    header.get("down"), taint,
+                )
             try:
-                sockio.send_frame(sock, wire.FTYPE_DATA, header, buffers)
-                return sockio.recv_frame(
+                t0 = time.monotonic()
+                sockio.send_frame(sock, wire.FTYPE_DATA, header, wire_bufs)
+                result = sockio.recv_frame(
                     sock, max_payload=wire.MAX_RESP_FRAME
                 )
+                linkhealth.observe_rtt(self._dest, time.monotonic() - t0)
+                return result
             except socket.timeout:
                 # The peer accepted the connection but stalled past the
                 # per-op timeout: the caller's timeout contract says fail
@@ -686,21 +774,41 @@ class _DestWorker(threading.Thread):
                 )
                 raise
 
-        try:
-            ftype, resp, _ = run_with_retry(
-                attempt_stream,
-                policy,
-                retry_on=(OSError,),
-                give_up_on=(_ConnectExhausted, socket.timeout),
-                deadline=deadline,
-                describe=f"send to {self._dest}",
-            )
-        except _ConnectExhausted as e:
-            raise e.__cause__ from None
+        # Frame-integrity NACKs requeue the clean buffers for resend,
+        # bounded by the policy's attempt budget — same contract as the
+        # pipelined lanes' CODE_DATA_CORRUPT requeue.
+        attempts = max(1, policy.max_attempts)
+        for crc_attempt in range(1, attempts + 1):
+            try:
+                ftype, resp, _ = run_with_retry(
+                    attempt_stream,
+                    policy,
+                    retry_on=(OSError,),
+                    give_up_on=(_ConnectExhausted, socket.timeout),
+                    deadline=deadline,
+                    describe=f"send to {self._dest}",
+                    backoff_ceiling_s=backoff_ceiling,
+                )
+            except _ConnectExhausted as e:
+                raise e.__cause__ from None
+            if ftype != wire.FTYPE_RESP:
+                raise wire.WireError(
+                    f"expected RESP frame, got ftype={ftype}"
+                )
+            if (
+                resp.get("code") == CODE_DATA_CORRUPT
+                and crc_attempt < attempts
+            ):
+                _m_crc_resends.inc()
+                logger.warning(
+                    "peer %s NACKed frame as corrupt; retransmitting "
+                    "(attempt %d/%d)",
+                    self._dest, crc_attempt, attempts,
+                )
+                continue
+            break
 
         self._proxy._bump_stat("send_op_count")
-        if ftype != wire.FTYPE_RESP:
-            raise wire.WireError(f"expected RESP frame, got ftype={ftype}")
         code = resp.get("code")
         if code == CODE_OK:
             return True
@@ -784,7 +892,13 @@ class TcpSenderProxy(SenderProxy):
 
     def get_stats(self) -> Dict:
         with self._stats_lock:
-            return dict(self._stats)
+            stats = dict(self._stats)
+        # Per-peer link estimator mirror (srtt/rttvar/loss) — the same
+        # numbers exported as fed_link_rtt_ms / fed_link_loss_ratio.
+        health = linkhealth.get_health().get_stats()
+        if health:
+            stats["link_health"] = health
+        return stats
 
     def get_proxy_config(self, dest_party: Optional[str] = None):
         """The effective messaging config — per-destination overrides
@@ -828,7 +942,12 @@ class TcpReceiverProxy(ReceiverProxy):
                 max_payload_bytes=self._config.effective_max_message_bytes(),
             ).offer
         )
-        self._offer = self._shm_adopter.offer
+        # Frame integrity wraps the whole chain: the crc is verified over
+        # the wire payload BEFORE any adoption/assembly/decode touches
+        # it, and a mismatch NACKs CODE_DATA_CORRUPT — the sender
+        # requeues the frame for retransmit (proxy/tcp/checksum.py).
+        self._crc_failures = 0
+        self._offer = self._verified_offer
         self._listener: Optional[socket.socket] = None
         self._ready_result = None
         self._open_conns: set = set()
@@ -839,6 +958,22 @@ class TcpReceiverProxy(ReceiverProxy):
         # are replaced by ServerConnection handlers on the shared loops.
         self._reactors = None
         self._next_reactor = 0
+
+    def _verified_offer(self, header, payload) -> Tuple[int, str]:
+        ok = checksum.verify(header, payload)
+        if ok is False:
+            self._crc_failures += 1
+            _m_crc_failures.inc()
+            key = (header.get("src"), header.get("up"), header.get("down"))
+            logger.warning(
+                "frame from %s (up=%s down=%s fseq=%s) failed crc "
+                "verification; NACKing for retransmit",
+                key[0], key[1], key[2], header.get("fseq"),
+            )
+            if sanitize.enabled():
+                sanitize.probe_crc_retransmit(key)
+            return (CODE_DATA_CORRUPT, "frame crc mismatch")
+        return self._shm_adopter.offer(header, payload)
 
     def _make_decode_fn(self):
         """Hook: the TPU receiver overrides this to add device placement."""
@@ -884,7 +1019,9 @@ class TcpReceiverProxy(ReceiverProxy):
         return self._store.take(upstream_seq_id, curr_seq_id)
 
     def get_stats(self) -> Dict:
-        return self._store.get_stats()
+        stats = self._store.get_stats()
+        stats["frame_crc_failures"] = self._crc_failures
+        return stats
 
     def ping_sources(self):
         return self._store.ping_sources()

@@ -48,6 +48,7 @@ from concurrent.futures import Future
 from typing import Any, Callable, Dict, Iterable, Optional
 
 from rayfed_tpu import tracing
+from rayfed_tpu.resilience import linkhealth
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
 
 logger = logging.getLogger(__name__)
@@ -128,12 +129,22 @@ class LivenessMonitor:
         self._thread: Optional[threading.Thread] = None
         # Fired exactly once per DEAD transition (the n == dead_after
         # edge), from the tick thread. Elastic membership registers the
-        # coordinator's eviction intake here.
+        # coordinator's eviction intake here (replaceable single slot);
+        # additional subscribers — shm in-flight reclamation, tests —
+        # stack via add_on_dead without displacing it.
         self._on_dead: Optional[Callable[[str], None]] = None
+        self._on_dead_extra: list = []
 
     # -- peer set mutation (elastic membership) ------------------------
     def set_on_dead(self, callback: Optional[Callable[[str], None]]) -> None:
         self._on_dead = callback
+
+    def add_on_dead(self, callback: Callable[[str], None]) -> None:
+        """Subscribe an ADDITIONAL DEAD-edge callback. Unlike
+        :meth:`set_on_dead` (a single slot membership owns), additive
+        subscribers accumulate — every one fires, in registration order,
+        after the slot callback."""
+        self._on_dead_extra.append(callback)
 
     def add_peer(self, party: str) -> None:
         """Start monitoring ``party`` (admitted mid-run). The monitored
@@ -175,19 +186,32 @@ class LivenessMonitor:
                 continue
             if fut.done():
                 del self._pending[p]
+                issued = self._issued_at.get(p)
                 try:
                     ok = bool(fut.result())
                 except BaseException:  # noqa: BLE001 - any failure = miss
                     ok = False
                 if ok:
+                    # Feed the link-health estimator. The sample is
+                    # settle-time minus issue-time, so it overshoots the
+                    # true RTT by up to one tick interval — a generous
+                    # bias, which is the safe direction for the adaptive
+                    # timeouts derived from it. Under link emulation the
+                    # shaped delay IS in this sample (probe futures
+                    # resolve after the emulated latency), making ping
+                    # RTT the emulation-visible health signal.
+                    if issued is not None:
+                        linkhealth.observe_rtt(p, now - issued)
                     self._hit(p)
                 else:
+                    linkhealth.observe_loss(p)
                     self._miss(p)
                 self._issue(p)
             elif now - self._issued_at[p] > timeout_s:
                 # Probe stuck in the transport's own retry loop: each
                 # further tick past the budget is a miss, but the probe
                 # stays out (one in flight per peer — no pile-up).
+                linkhealth.observe_loss(p)
                 self._miss(p)
 
     def _issue(self, p: str) -> None:
@@ -224,12 +248,16 @@ class LivenessMonitor:
                 "party %s missed %d consecutive heartbeat(s): %s",
                 p, n, self._state_for(n),
             )
-        if n == self._config.dead_after and self._on_dead is not None:
-            try:
-                self._on_dead(p)
-            except Exception:  # noqa: BLE001 - callback must not kill ticks
-                logger.warning("liveness on-dead callback failed",
-                               exc_info=True)
+        if n == self._config.dead_after:
+            callbacks = (
+                [self._on_dead] if self._on_dead is not None else []
+            ) + list(self._on_dead_extra)
+            for cb in callbacks:
+                try:
+                    cb(p)
+                except Exception:  # noqa: BLE001 - must not kill ticks
+                    logger.warning("liveness on-dead callback failed",
+                                   exc_info=True)
 
     def _state_for(self, misses: int) -> str:
         if misses >= self._config.dead_after:

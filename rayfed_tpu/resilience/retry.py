@@ -157,6 +157,7 @@ def run_with_retry(
     deadline: Optional[Deadline] = None,
     describe: str = "operation",
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    backoff_ceiling_s: Optional[float] = None,
 ) -> Any:
     """Run ``fn(attempt)`` (attempt is 1-based) under ``policy``.
 
@@ -166,7 +167,18 @@ def run_with_retry(
     already consumed its per-op budget — re-dialing won't help and the
     caller's timeout contract says fail now). A ``deadline``, when given,
     bounds the whole loop: backoffs are clipped to the remaining budget
-    and no new attempt starts once it expires.
+    and no new attempt starts once it expires. ``backoff_ceiling_s``
+    additionally caps every pause below the policy's own ``max_backoff_ms``
+    — the link-health layer passes an RTT-derived ceiling here so a 5ms
+    link never sleeps a WAN-tuned 30s between attempts.
+
+    The backoff clamp is deadline-aware in BOTH directions: a pause is
+    never allowed to swallow the whole remaining budget. The loop tracks
+    the cost of the slowest attempt so far and shortens the pause so the
+    next (possibly final) attempt starts with at least that much budget
+    left — without this, a WAN-scale backoff (5s initial) against a 6s
+    deadline burns the budget sleeping and the "final attempt" is a
+    0ms-budget formality that can only fail.
 
     On exhaustion raises a plain ``ConnectionError`` — callers (and the
     sending-failure handler contract, see
@@ -177,12 +189,15 @@ def run_with_retry(
     """
     attempts = max(1, policy.max_attempts)
     last_err: Optional[BaseException] = None
+    attempt_cost = 0.0  # slowest observed attempt, the final-fit reserve
     for attempt in range(1, attempts + 1):
+        t_start = time.monotonic()
         try:
             return fn(attempt)
         except give_up_on:
             raise
         except retry_on as e:
+            attempt_cost = max(attempt_cost, time.monotonic() - t_start)
             last_err = e
             if attempt >= attempts:
                 break
@@ -191,10 +206,20 @@ def run_with_retry(
             if on_retry is not None:
                 on_retry(attempt, e)
             pause = policy.backoff_s(attempt)
+            if backoff_ceiling_s is not None:
+                pause = min(pause, max(0.0, backoff_ceiling_s))
             if policy.jitter:
                 pause *= 0.5 + 0.5 * random.random()
             if deadline is not None:
-                pause = deadline.clip(pause)
+                # Reserve room for the attempt that follows the pause:
+                # sleep at most (remaining - one attempt's cost), so the
+                # final attempt always FITS the deadline instead of
+                # starting exactly as it expires.
+                rem = deadline.remaining()
+                if rem is not None:
+                    pause = min(
+                        pause, max(0.0, rem - max(attempt_cost, 0.001))
+                    )
             if pause > 0:
                 time.sleep(pause)
     raise ConnectionError(

@@ -39,6 +39,11 @@ probe catalog (see ``docs/sanitizer.md`` for the contract):
 ``donation-aliasing``
     a value resolved by ``fed.get`` must not contain deleted (donated)
     jax buffers.
+``crc-retransmit-idempotence``
+    a frame NACKed for a crc mismatch must be retransmitted from the
+    sender's clean stored buffers — the same frame key failing
+    verification repeatedly means the retransmit path re-sends
+    corrupted bytes.
 
 Every probe body begins with the enabled test, so the disabled cost is
 one module-global read per seam (the overhead contract in
@@ -77,6 +82,8 @@ _state_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (gua
 _send_seq: Dict[Tuple[str, Optional[int]], int] = {}  # fedlint: disable=global-mutable-singleton (sanitizer probe state, reset() clears)
 #: lane id -> thread ident that set _inline_busy.
 _inline_owner: Dict[int, int] = {}  # fedlint: disable=global-mutable-singleton (sanitizer probe state, reset() clears)
+#: frame key -> crc verification failure count.
+_crc_nacks: Dict[Tuple, int] = {}  # fedlint: disable=global-mutable-singleton (sanitizer probe state, reset() clears)
 #: check name -> trip count (mirrors the telemetry counter for tests).
 _trips: Dict[str, int] = {}  # fedlint: disable=global-mutable-singleton (sanitizer probe state, reset() clears)
 
@@ -103,6 +110,7 @@ def reset() -> None:
     with _state_lock:
         _send_seq.clear()
         _inline_owner.clear()
+        _crc_nacks.clear()
         _trips.clear()
 
 
@@ -244,6 +252,30 @@ def probe_inline_busy_clear(lane_id: int) -> None:
         "inline-busy-ownership",
         f"lane {lane_id:#x} _inline_busy cleared by thread {ident} but "
         f"was set by thread {prev}: cross-thread gate handoff",
+    )
+
+
+def probe_crc_retransmit(key: Tuple, limit: int = 2) -> None:
+    """``crc-retransmit-idempotence``: called on every crc verification
+    failure with the frame's (src, up, down) key. A NACKed frame is
+    retransmitted from the sender's CLEAN stored buffers, so under the
+    single-bit chaos taint one key fails at most once; ``limit`` leaves
+    headroom for a genuinely noisy link. More failures than that for the
+    SAME key means the retransmit path is re-sending corrupted bytes —
+    the stored buffers themselves were mutated."""
+    if not _enabled:
+        return
+    with _state_lock:
+        n = _crc_nacks.get(key, 0) + 1
+        _crc_nacks[key] = n
+        if n <= limit:
+            return
+    _trip(
+        "crc-retransmit-idempotence",
+        f"frame {key} failed crc verification {n} times: retransmits "
+        f"must carry the sender's clean stored buffers, so repeated "
+        f"mismatches on one key mean the stored payload itself is "
+        f"corrupted",
     )
 
 

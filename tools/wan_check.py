@@ -1,0 +1,139 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""WAN-transport regression gate: FedAvg over an emulated 50ms/100Mbit link.
+
+Runs bench.py's ``_wan_party`` stage (3 real spawned parties, real
+sockets, the in-proxy LinkProfile shaper adding deterministic 50ms
+latency + 100Mbit token-bucket pacing to every edge, frame crc and
+adaptive deadlines on) and FAILS LOUDLY (exit 1) when:
+
+- the stage produces no result at all (a WAN-regime hang: adaptive
+  deadlines mis-clamped below the link RTT turn every round into a
+  retry storm that the stage timeout eventually kills);
+- ``wan_round_ms`` exceeds the budget — on a 50ms link a round is
+  latency-bound near the RTT floor, so a multiple of it means the
+  transport added round trips (lost adaptive acks, spurious resends,
+  crc NACKs on clean frames);
+- ``wan_round_ms`` lands BELOW the physical floor — a round that beats
+  one-way light time over the emulated link means the shaper stopped
+  shaping, and the "WAN" stage quietly measures loopback;
+- ``link_rtt_ms`` does not reflect the emulated latency — the
+  LinkHealth estimator went blind (liveness ping RTTs no longer feed
+  it), which silently disables every adaptive deadline it drives.
+
+Knobs:
+
+  FEDTPU_WAN_ROUND_BUDGET_MS  default 400 — max median round latency
+                              (measured ~65-90ms on 1-core CI hosts;
+                              the budget leaves ~4x headroom for host
+                              noise, not for extra round trips).
+  FEDTPU_WAN_ROUND_FLOOR_MS   default 45 — the shaper-is-alive floor
+                              (one-way 50ms minus scheduling slop).
+  FEDTPU_WAN_RTT_FLOOR_MS     default 40 — minimum converged srtt.
+  FEDTPU_WAN_ROUNDS           default 6 — FedAvg rounds per run.
+  FEDTPU_WAN_WALL_BUDGET_S    default 300 — hard cap on the whole check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    round_budget_ms = float(
+        os.environ.get("FEDTPU_WAN_ROUND_BUDGET_MS", "400")
+    )
+    round_floor_ms = float(os.environ.get("FEDTPU_WAN_ROUND_FLOOR_MS", "45"))
+    rtt_floor_ms = float(os.environ.get("FEDTPU_WAN_RTT_FLOOR_MS", "40"))
+    rounds = os.environ.get("FEDTPU_WAN_ROUNDS", "6")
+    wall_budget_s = float(os.environ.get("FEDTPU_WAN_WALL_BUDGET_S", "300"))
+    t0 = time.monotonic()
+
+    os.environ.setdefault("FEDTPU_BENCH_WAN_ROUNDS", rounds)
+    out = bench._bench_stage(
+        bench._wan_party, "round_ms", "FEDTPU_BENCH_WAN_ROUNDS", 8,
+        [("tcp", "wan_round_ms")], cpu_force=True, parties=bench._WAN3,
+        timeout_s=min(240.0, wall_budget_s), digits=1,
+        extra_fields={
+            "link_rtt_ms": "link_rtt_ms",
+            "wan_rounds": "wan_rounds",
+        },
+    )
+    elapsed = time.monotonic() - t0
+    print(f"wan stage: {out} ({elapsed:.0f}s)", flush=True)
+
+    if elapsed > wall_budget_s:
+        print(
+            f"WAN GATE WALL-CLOCK BREACH: {elapsed:.0f}s elapsed exceeds "
+            f"the {wall_budget_s:.0f}s budget — a WAN-regime hang (adaptive "
+            f"deadlines below the link RTT), not just a slow host.",
+            file=sys.stderr,
+        )
+        return 1
+    if "wan_round_ms" not in out:
+        print(
+            "WAN GATE STAGE FAILED: _wan_party produced no result (see the "
+            "'bench skipped' note above) — the 3-party run over the shaped "
+            "link hung or crashed.",
+            file=sys.stderr,
+        )
+        return 1
+    round_ms = out["wan_round_ms"]
+    if round_ms > round_budget_ms:
+        print(
+            f"WAN TRANSPORT REGRESSION: wan_round_ms {round_ms:.1f} exceeds "
+            f"the {round_budget_ms:.0f}ms budget. On a 50ms link a FedAvg "
+            f"round is latency-bound near the RTT floor; a multiple of it "
+            f"means added round trips — ack timeouts firing below the "
+            f"shaped RTT (adaptive clamp broken), spurious crc NACKs on "
+            f"clean frames, or recv deadlines expiring and retrying.",
+            file=sys.stderr,
+        )
+        return 1
+    if round_ms < round_floor_ms:
+        print(
+            f"WAN GATE SHAPER DEAD: wan_round_ms {round_ms:.1f} beats the "
+            f"{round_floor_ms:.0f}ms one-way-latency floor — the "
+            f"LinkProfile shaper is no longer delaying frames, so this "
+            f"stage quietly measures loopback and gates nothing.",
+            file=sys.stderr,
+        )
+        return 1
+    rtt_ms = out.get("link_rtt_ms", 0.0)
+    if rtt_ms < rtt_floor_ms:
+        print(
+            f"WAN GATE ESTIMATOR BLIND: link_rtt_ms {rtt_ms:.1f} is below "
+            f"the {rtt_floor_ms:.0f}ms floor on a 50ms emulated link — "
+            f"liveness ping round-trips are no longer feeding the "
+            f"LinkHealth estimator, which silently disables the adaptive "
+            f"ack timeouts, recv-deadline slack, and backoff ceilings "
+            f"derived from it.",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"wan gate passed in {time.monotonic() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
