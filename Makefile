@@ -1,6 +1,6 @@
 # Build the native fastwire extension in place (optional: the transport
 # falls back to pure-Python socket IO when the extension is absent).
-.PHONY: native test lint sanitize chaos latency scale dma shm serve async churn obs privacy ha wan clean
+.PHONY: native test lint sanitize chaos latency scale dma shm serve async churn obs privacy ha wan tenant clean
 
 native:
 	python setup.py build_ext --inplace
@@ -147,6 +147,19 @@ ha:
 wan:
 	JAX_PLATFORMS=cpu python tools/wan_check.py
 	JAX_PLATFORMS=cpu python -m pytest tests/test_wan.py -q
+
+# Tenancy gate (docs/multitenancy.md): the full tenancy unit suite +
+# the multitenant_isolation chaos test, then tools/tenant_check.py —
+# byte-identical isolation between co-resident jobs (non-negotiable)
+# and the weighted-fair QoS keys from bench.py's tenant stage:
+# tenant_fairness_ratio >= FEDTPU_TENANT_FAIRNESS (default 0.25 at the
+# 1:4 weight split) and multitenant_victim_p99_ms under
+# FEDTPU_TENANT_P99_MS. Mirrors the `tenant` job in
+# .github/workflows/tests.yml.
+tenant:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tenancy.py \
+	  tests/test_multitenant_chaos.py -q
+	JAX_PLATFORMS=cpu python tools/tenant_check.py
 
 clean:
 	rm -rf build rayfed_tpu/_fastwire*.so

@@ -1378,6 +1378,147 @@ def _serve_bench_entry(result_path, clients, requests_per_client, reps):
         json.dump(out, f)
 
 
+def _tenant_bench_entry(result_path, window_s, push_mb, inline_kb):
+    """Child-process body of the tenant stage: two jobs share one
+    listener (the piggyback path), both keep bulk backlog through the
+    weighted-fair gate at weights 4:1, while the victim job's inline
+    serving-class round trips are latency-sampled. Emits the two keys
+    tools/tenant_check.py gates: ``tenant_fairness_ratio`` (weight-
+    normalized bulk byte ratio, 1.0 = perfectly fair) and
+    ``multitenant_victim_p99_ms``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    import numpy as np
+
+    from rayfed_tpu.proxy.tcp.tcp_proxy import (
+        TcpReceiverProxy,
+        TcpSenderProxy,
+    )
+    from rayfed_tpu.tenancy import qos as tenancy_qos
+    from rayfed_tpu.tenancy.context import TenancyConfig
+
+    fast = {"retry_policy": {"max_attempts": 10, "initial_backoff_ms": 100}}
+    sched = tenancy_qos.get_scheduler()
+    sched.register("victim", TenancyConfig(weight=4, fair_window_mb=2))
+    sched.register("noisy", TenancyConfig(weight=1, fair_window_mb=2))
+
+    (port,) = _free_ports(1)
+    addrs = {"bob": f"127.0.0.1:{port}"}
+    receivers = {
+        job: TcpReceiverProxy(addrs["bob"], "bob", job, None, dict(fast))
+        for job in ("victim", "noisy")
+    }
+    senders = {
+        job: TcpSenderProxy(addrs, "alice", job, None, dict(fast))
+        for job in ("victim", "noisy")
+    }
+    for p in list(receivers.values()) + list(senders.values()):
+        p.start()
+
+    deadline = time.monotonic() + window_s
+    bulk_payload = np.arange((push_mb << 20) // 4, dtype=np.uint32)
+    inline_payload = np.arange((inline_kb << 10), dtype=np.uint8)
+    errors = []
+
+    def bulk_loop(job, base):
+        try:
+            i = 0
+            while time.monotonic() < deadline:
+                seq = base + 2 * i
+                fut = receivers[job].get_data("alice", f"{seq}#0", seq + 1)
+                senders[job].send(
+                    "bob", bulk_payload, f"{seq}#0", seq + 1
+                ).result(60)
+                fut.result(60)
+                i += 1
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(f"{job} bulk: {e!r}")
+
+    latencies = []
+
+    def inline_loop():
+        try:
+            i = 0
+            while time.monotonic() < deadline:
+                seq = 1 + 2 * i  # odd ids: disjoint from the bulk range
+                fut = receivers["victim"].get_data(
+                    "alice", f"{seq}#0", seq + 1
+                )
+                t0 = time.monotonic()
+                senders["victim"].send(
+                    "bob", inline_payload, f"{seq}#0", seq + 1
+                )
+                fut.result(60)
+                latencies.append((time.monotonic() - t0) * 1e3)
+                i += 1
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(f"victim inline: {e!r}")
+
+    threads = [
+        threading.Thread(target=bulk_loop, args=("noisy", 1_000_000)),
+        threading.Thread(target=bulk_loop, args=("victim", 2_000_000)),
+        threading.Thread(target=inline_loop),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=window_s + 120)
+    for p in list(senders.values()) + [receivers["noisy"],
+                                       receivers["victim"]]:
+        try:
+            p.stop()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+    if errors or not latencies:
+        raise RuntimeError(f"tenant bench failed: {errors or 'no samples'}")
+    ratio = sched.fairness_ratio("victim", "noisy")
+    lat = sorted(latencies)
+    out = {
+        "tenant_fairness_ratio": round(ratio, 3) if ratio else None,
+        "multitenant_victim_p99_ms": round(
+            lat[int(0.99 * (len(lat) - 1))], 2
+        ),
+        "multitenant_victim_p50_ms": round(lat[len(lat) // 2], 2),
+        "tenant_inline_samples": len(lat),
+        "tenant_bulk_mb": {
+            job: round(
+                sched.bytes_sent(job, tenancy_qos.TC_BULK) / (1 << 20), 1
+            )
+            for job in ("victim", "noisy")
+        },
+    }
+    with open(result_path, "w") as f:
+        json.dump(out, f)
+
+
+def _run_tenant_bench() -> dict:
+    """Tenant-fairness stage (docs/multitenancy.md); spawned CPU-forced
+    child, same isolation rationale as the psum stage."""
+    mp = multiprocessing.get_context("spawn")
+    with _cpu_forced(), tempfile.TemporaryDirectory() as tmp:
+        result_path = os.path.join(tmp, "tenant.json")
+        p = mp.Process(
+            target=_tenant_bench_entry,
+            args=(
+                result_path,
+                float(os.environ.get("FEDTPU_BENCH_TENANT_WINDOW_S", 6)),
+                int(os.environ.get("FEDTPU_BENCH_TENANT_PUSH_MB", 4)),
+                int(os.environ.get("FEDTPU_BENCH_TENANT_INLINE_KB", 4)),
+            ),
+        )
+        p.start()
+        p.join(timeout=300)
+        if p.is_alive():
+            p.kill()
+            p.join(timeout=30)
+            raise RuntimeError("tenant bench child hung")
+        if p.exitcode != 0 or not os.path.exists(result_path):
+            raise RuntimeError(f"tenant bench child failed rc={p.exitcode}")
+        with open(result_path) as f:
+            return json.load(f)
+
+
 def _run_serve_bench() -> dict:
     """``serve_tokens_s`` / ``serve_p99_ms`` (+``_spread``) from >=8
     concurrent clients with hot swaps mid-window, plus the
@@ -2626,6 +2767,13 @@ def main() -> None:
         result.update(_run_serve_bench())
     except Exception as e:  # noqa: BLE001 - bench must still print its line
         print(f"serve bench skipped: {e!r}", file=sys.stderr)
+    # Tenancy plane: weighted-fair sharing between two jobs on one
+    # shared listener + the victim's inline p99 under a noisy neighbor
+    # (docs/multitenancy.md; tools/tenant_check.py gates both keys).
+    try:
+        result.update(_run_tenant_bench())
+    except Exception as e:  # noqa: BLE001 - bench must still print its line
+        print(f"tenant bench skipped: {e!r}", file=sys.stderr)
     if _DIAGNOSTICS:
         result["diagnostics"] = _DIAGNOSTICS
     print(json.dumps(result))

@@ -37,6 +37,7 @@ Dataflow contract:
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from queue import Queue
@@ -95,9 +96,9 @@ class _StealableTask:
     claims first runs, the other does nothing."""
 
     __slots__ = ("fn", "args", "kwargs", "out", "num_returns",
-                 "_lock", "_claimed")
+                 "_lock", "_claimed", "_ctx")
 
-    def __init__(self, fn, args, kwargs, out, num_returns):
+    def __init__(self, fn, args, kwargs, out, num_returns, ctx=None):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
@@ -105,6 +106,10 @@ class _StealableTask:
         self.num_returns = num_returns
         self._lock = threading.Lock()
         self._claimed = False
+        # Submitter's contextvar snapshot: pool workers (and thieves on
+        # foreign drivers) must resolve the same FedContext the task was
+        # submitted under, or a co-tenant's JobScoped state would leak in.
+        self._ctx = ctx
 
     def claim(self) -> bool:
         with self._lock:
@@ -118,11 +123,15 @@ class _StealableTask:
             self._execute()
 
     def _execute(self) -> None:
-        _run_task(self.fn, self.args, self.kwargs, self.out,
-                  self.num_returns)
+        if self._ctx is not None:
+            self._ctx.run(_run_task, self.fn, self.args, self.kwargs,
+                          self.out, self.num_returns)
+        else:
+            _run_task(self.fn, self.args, self.kwargs, self.out,
+                      self.num_returns)
         # Drop payload refs promptly: the out-futures keep this shell
         # alive via their steal attribute until they are collected.
-        self.fn = self.args = self.kwargs = self.out = None
+        self.fn = self.args = self.kwargs = self.out = self._ctx = None
 
 
 _steal_depth = threading.local()
@@ -260,14 +269,33 @@ class LocalExecutor:
             for f in out if isinstance(out, list) else [out]:
                 f.set_exception(exc)
 
+        def _charge_slot() -> Optional[str]:
+            # Tenant quota on pool/lane occupancy ("executor_tasks"):
+            # eager-inline tasks run on the caller's own thread and are
+            # exempt — the quota caps how much of the SHARED worker pool
+            # one tenant may hold. Raises TenantQuotaExceeded loudly.
+            from rayfed_tpu.tenancy.context import current_job
+            from rayfed_tpu.tenancy.qos import get_ledger
+
+            job = current_job()
+            get_ledger().charge(job, "executor_tasks", 1)
+            first = out[0] if isinstance(out, list) else out
+            first.add_done_callback(
+                lambda _f: get_ledger().release(job, "executor_tasks", 1)
+            )
+            return job
+
         if lane is not None:
             from rayfed_tpu.exceptions import FedActorKilledError
+
+            _charge_slot()
+            task_ctx = contextvars.copy_context()
 
             def thunk() -> None:
                 if lane.killed:
                     fail_all(FedActorKilledError("actor was killed"))
                     return
-                _run_task(fn, args, kwargs, out, num_returns)
+                task_ctx.run(_run_task, fn, args, kwargs, out, num_returns)
 
             if not lane.submit_thunk(thunk):
                 fail_all(FedActorKilledError("actor was killed"))
@@ -289,7 +317,11 @@ class LocalExecutor:
             # driver could never issue the concurrent work it waits on.
             _run_task(fn, args, kwargs, out, num_returns)
         else:
-            task = _StealableTask(fn, args, kwargs, out, num_returns)
+            _charge_slot()
+            task = _StealableTask(
+                fn, args, kwargs, out, num_returns,
+                ctx=contextvars.copy_context(),
+            )
             for f in out if isinstance(out, list) else [out]:
                 f._fedtpu_steal = task
             self._pool.submit(task.run_if_unclaimed)

@@ -147,8 +147,13 @@ class GlobalContext:
             self._atomic_shutdown_flag_lock.release()
 
 
-_global_context: Optional[GlobalContext] = None  # fedlint: disable=global-mutable-singleton (job context registry; cleared by clear_global_context at shutdown)
-_context_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (job context registry; cleared by clear_global_context at shutdown)
+# Tenancy: one GlobalContext per job, resolved through the ambient
+# FedContext (tenancy/context.py) so concurrent fed.init jobs in one
+# process each see their own seq counters, cleanup manager and executor.
+from rayfed_tpu.tenancy.context import JobScoped
+
+_contexts: "JobScoped[GlobalContext]" = JobScoped("global_context")
+_context_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards per-job context slots; cleared by clear_global_context at shutdown)
 
 
 def init_global_context(
@@ -160,10 +165,13 @@ def init_global_context(
     party_process_id: int = 0,
     party_num_processes: int = 1,
 ) -> GlobalContext:
-    global _global_context
+    from rayfed_tpu.tenancy.context import current_job
+
     with _context_lock:
-        if _global_context is None:
-            _global_context = GlobalContext(
+        job = current_job() or job_name
+        existing = _contexts.peek(job)
+        if existing is None:
+            existing = GlobalContext(
                 job_name,
                 current_party,
                 sending_failure_handler=sending_failure_handler,
@@ -174,19 +182,17 @@ def init_global_context(
                 party_process_id=party_process_id,
                 party_num_processes=party_num_processes,
             )
-        return _global_context
+            _contexts.set(existing, job=job)
+        return existing
 
 
 def get_global_context() -> Optional[GlobalContext]:
-    return _global_context
+    return _contexts.peek()
 
 
 def clear_global_context(wait_for_sending: bool = False) -> None:
-    global _global_context
     with _context_lock:
-        if _global_context is not None:
-            _global_context.get_cleanup_manager().stop(
-                wait_for_sending=wait_for_sending
-            )
-            _global_context.get_executor().shutdown(wait=False)
-            _global_context = None
+        ctx = _contexts.pop()
+        if ctx is not None:
+            ctx.get_cleanup_manager().stop(wait_for_sending=wait_for_sending)
+            ctx.get_executor().shutdown(wait=False)

@@ -36,7 +36,7 @@ import threading
 from typing import Dict, Optional
 
 _lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
-_initialized_job: Optional[str] = None  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
+_initialized_jobs: set = set()  # fedlint: disable=global-mutable-singleton (process KV backend; kv_reset() clears it at shutdown)
 
 
 class _MemoryBackend:
@@ -53,7 +53,12 @@ class _MemoryBackend:
         self._store.pop(key, None)
 
     def clear(self, key_prefix: Optional[str] = None) -> None:
-        self._store.clear()
+        if key_prefix is None:
+            self._store.clear()
+            return
+        # Scope to one job's keys — several jobs share this process store.
+        for key in [k for k in self._store if k.startswith(key_prefix)]:
+            self._store.pop(key, None)
 
 
 class _FileBackend:
@@ -148,14 +153,13 @@ def wrap_kv_key(job_name: str, key: str) -> str:
 
 
 def kv_initialize(job_name: str) -> bool:
-    global _initialized_job
     with _lock:
-        _initialized_job = job_name
+        _initialized_jobs.add(job_name)
         return True
 
 
 def kv_initialized() -> bool:
-    return _initialized_job is not None
+    return bool(_initialized_jobs)
 
 
 def kv_put(job_name: str, key: str, value: bytes) -> bool:
@@ -176,15 +180,25 @@ def kv_delete(job_name: str, key: str) -> bool:
 
 
 def kv_reset() -> None:
-    """Clear this job's keys and revert to the in-process backend
-    (ref ``compatible_utils.py:179-186``)."""
-    global _initialized_job, _backend
+    """Clear the current job's keys; revert to the in-process backend
+    only once no initialized job remains (ref ``compatible_utils.py:
+    179-186``) — rebinding the backend under a live co-tenant would nuke
+    its keys."""
+    from rayfed_tpu.tenancy.context import current_job
+
+    global _backend
     with _lock:
-        prefix = (
-            wrap_kv_key(_initialized_job, "")
-            if _initialized_job is not None
-            else None
-        )
-        _backend.clear(prefix)
-        _backend = _MemoryBackend()
-        _initialized_job = None
+        job = current_job()
+        if job is None and len(_initialized_jobs) == 1:
+            job = next(iter(_initialized_jobs))
+        if job is not None:
+            # Scoped to the resolved job even when it is no longer
+            # initialized (idempotent re-run) — falling back to "some
+            # other job" here would nuke a live co-tenant's keys.
+            _backend.clear(wrap_kv_key(job, ""))
+            _initialized_jobs.discard(job)
+        else:
+            _backend.clear(None)
+            _initialized_jobs.clear()
+        if not _initialized_jobs:
+            _backend = _MemoryBackend()

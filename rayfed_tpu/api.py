@@ -35,6 +35,7 @@ import inspect
 import logging
 import pickle
 import signal
+import threading
 import sys
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -167,6 +168,17 @@ def init(
         from rayfed_tpu.checkpoint import CheckpointConfig
 
         CheckpointConfig.from_dict(checkpoint_dict)
+    # The tenancy section is STRICT too: a typo'd quota key must reject
+    # init here — a tenant silently running unbounded defeats the whole
+    # QoS/quota contract (docs/multitenancy.md).
+    from rayfed_tpu.tenancy.context import TenancyConfig
+
+    tenancy_dict = config.get("tenancy")
+    tenancy_cfg = (
+        TenancyConfig.from_dict(tenancy_dict)
+        if tenancy_dict is not None
+        else None
+    )
     transport = transport or config.get("transport", "tcp")
     if (
         transport == "grpc"
@@ -189,6 +201,19 @@ def init(
     party_num_processes = (
         int(jax_dist.get("num_processes", 1)) if jax_dist else 1
     )
+
+    # Tenancy plane first: the FedContext is the per-job home every other
+    # plane's JobScoped state resolves through, so it must exist (and be
+    # bound to this thread) before anything below builds state. Also
+    # registers the job with the weighted-fair transport scheduler.
+    from rayfed_tpu.tenancy import context as tenancy_context
+    from rayfed_tpu.tenancy import qos as tenancy_qos
+
+    fed_ctx = tenancy_context.create_context(
+        job_name, party, tenancy=tenancy_cfg
+    )
+    tenancy_context.activate(fed_ctx)
+    tenancy_qos.get_scheduler().register(job_name, fed_ctx.tenancy)
 
     init_global_context(
         job_name=job_name,
@@ -242,7 +267,11 @@ def init(
     )
     logger.info("Started rayfed_tpu with %s", cluster_config)
 
-    signal.signal(signal.SIGINT, _signal_handler)
+    # Signal handlers can only be installed from the main thread; a
+    # secondary job initialized from a worker thread (multi-tenant
+    # process) simply shares the handler the first job installed.
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGINT, _signal_handler)
     get_global_context().get_cleanup_manager().start(
         exit_on_sending_failure=cross_silo_comm_config.exit_on_sending_failure,
         expose_error_trace=cross_silo_comm_config.expose_error_trace,
@@ -390,7 +419,13 @@ def init(
             # in-flight ring chunks would leak until ring close: reclaim
             # them on the DEAD edge. Additive subscription — membership
             # (wired below, after this block) owns the set_on_dead slot.
-            monitor.add_on_dead(barriers.cancel_peer_inflight)
+            # The monitor's thread never inherited this job's contextvar,
+            # so the callback re-binds it explicitly.
+            def _reclaim_dead_peer(*args, _ctx=fed_ctx, **kwargs):
+                with tenancy_context.use_context(_ctx):
+                    return barriers.cancel_peer_inflight(*args, **kwargs)
+
+            monitor.add_on_dead(_reclaim_dead_peer)
 
     # Elastic membership (docs/membership.md): every founding party builds
     # the same epoch-0 view from the init addresses and installs the
@@ -468,6 +503,25 @@ def shutdown():
 
 
 def _shutdown(intended: bool = True):
+    if get_global_context() is None:
+        return
+    # Bind the job being shut down to this thread for the whole teardown:
+    # every plane's JobScoped lookups below must resolve THIS job even
+    # when shutdown is called from a thread that never ran fed.init (or
+    # while other jobs are live in the process).
+    from rayfed_tpu.tenancy import context as tenancy_context
+
+    fed_ctx = tenancy_context.get_context(
+        get_global_context().get_job_name()
+    )
+    if fed_ctx is not None:
+        with tenancy_context.use_context(fed_ctx):
+            _shutdown_impl(intended)
+    else:
+        _shutdown_impl(intended)
+
+
+def _shutdown_impl(intended: bool = True):
     if get_global_context() is None:
         return
 
@@ -583,8 +637,23 @@ def _shutdown(intended: bool = True):
     # so the monotonicity watermarks (and the other probe maps) must not
     # carry across or the first send of the next job trips spuriously.
     sanitize.reset()
+    # Completeness sweep (docs/multitenancy.md): every reset hook in the
+    # singleton-inventory table runs for this job — the ordered teardown
+    # above covers the drains that need arguments; the sweep guarantees
+    # no plane's per-job state survives, including planes init never
+    # touched. GLOBAL-scope hooks (party mesh, DMA server, tracing
+    # buffers, the QoS arbiter itself) only fire when this was the last
+    # live job. Then the job leaves the scheduler and context registry.
+    from rayfed_tpu.tenancy import context as tenancy_context
+    from rayfed_tpu.tenancy import reset as tenancy_reset
+
+    job = ctx.get_job_name()
+    last = len(tenancy_context.contexts()) <= 1
+    tenancy_reset.run_all_reset_hooks(job, last=last)
+    tenancy_context.remove_context(job)
     logger.info("Shutdown rayfed_tpu.")
-    signal.signal(signal.SIGINT, original_sigint)
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGINT, original_sigint)
     if exit_on_sending_failure:
         logger.critical("Exit now due to the previous error.")
         sys.exit(1)

@@ -664,8 +664,10 @@ class BufferedAggregator:
 # Process-local session registry (lives at the aggregating party)
 # ---------------------------------------------------------------------------
 
-_sessions: Dict[str, BufferedAggregator] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
-_sessions_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_sessions: JobScoped = JobScoped("async_rounds.sessions", default_factory=dict)
+_sessions_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards the per-job session registries)
 
 
 def _serve_publish_cb(serve_name: str) -> Callable[[int, Any], None]:
@@ -681,7 +683,8 @@ def _get_or_create_session(
     name: str, cfg_dict: Dict[str, Any], serve_name: Optional[str]
 ) -> BufferedAggregator:
     with _sessions_lock:
-        agg = _sessions.get(name)
+        sessions = _sessions.get()
+        agg = sessions.get(name)
         if agg is None:
             from rayfed_tpu.resilience.liveness import liveness_view
 
@@ -693,7 +696,7 @@ def _get_or_create_session(
                 ),
                 session=name,
             )
-            _sessions[name] = agg
+            sessions[name] = agg
         return agg
 
 
@@ -701,17 +704,17 @@ def get_session(name: str = "default") -> Optional[BufferedAggregator]:
     """The named session's aggregator in THIS process (None when this
     process is not the aggregating party, or nothing arrived yet)."""
     with _sessions_lock:
-        return _sessions.get(name)
+        return _sessions.get().get(name)
 
 
 def reset_sessions() -> None:
     """Drop all aggregator state and driver-side round counters (called
     by ``fed.shutdown`` — a new job must not fold into an old buffer)."""
     with _sessions_lock:
-        _sessions.clear()
+        _sessions.pop()
     with _tags_lock:
-        _driver_round_tags.clear()
-        _last_rounds.clear()
+        _driver_round_tags.pop()
+        _last_rounds.pop()
 
 
 def poke_secure_sessions() -> None:
@@ -719,7 +722,7 @@ def poke_secure_sessions() -> None:
     calls this when a ``prv:recover`` seed lands — a dropout-blocked
     group may now be completable)."""
     with _sessions_lock:
-        aggs = list(_sessions.values())
+        aggs = list(_sessions.get().values())
     for agg in aggs:
         agg.poke_secure()
 
@@ -823,47 +826,48 @@ def _async_stats(name, cfg_dict, serve_name):
 # Job default (config['aggregation']['async_*'] from fed.init), following
 # the topology.set_default pattern: every driver reads the same config,
 # so every driver ships the identical cfg to the root.
-_default_cfg_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
-_default_cfg: Optional[AsyncAggregationConfig] = None  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_default_cfgs: "JobScoped[AsyncAggregationConfig]" = JobScoped(
+    "async_rounds.default_cfg"
+)
 
 # Driver-side auto round tags, per session name. Every driver runs the
 # same program, so the counters advance identically on all parties.
-_tags_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
-_driver_round_tags: Dict[str, int] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_tags_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards the per-job round-tag counters)
+_driver_round_tags: JobScoped = JobScoped(
+    "async_rounds.round_tags", default_factory=dict
+)
 
 # Driver-side memory of the last async_round call per session — the
 # survivor re-offer source for :func:`async_rebuild` when the root died
 # without handing its buffer off. Identical on every driver (same calls,
 # same arguments), so a rebuild lays out the same DAG everywhere.
-_last_rounds: Dict[str, Dict[str, Any]] = {}  # fedlint: disable=global-mutable-singleton (per-job async state; reset_sessions()/reset_default_async_config() clear at shutdown)
+_last_rounds: JobScoped = JobScoped(
+    "async_rounds.last_rounds", default_factory=dict
+)
 
 
 def set_default_async_config(aggregation_dict: Dict[str, Any]) -> None:
     """Validate and install the ``aggregation.async_*`` job defaults
     (called by ``fed.init``; raises on unknown keys or bad values so a
     typo'd config rejects init, not the first round)."""
-    global _default_cfg
     cfg = AsyncAggregationConfig.from_aggregation_dict(aggregation_dict)
     resolve_staleness_fn(cfg.staleness, cfg.staleness_exp)  # validate combo
-    with _default_cfg_lock:
-        _default_cfg = cfg
+    _default_cfgs.set(cfg)
 
 
 def get_default_async_config() -> AsyncAggregationConfig:
-    with _default_cfg_lock:
-        return _default_cfg or AsyncAggregationConfig()
+    return _default_cfgs.peek() or AsyncAggregationConfig()
 
 
 def reset_default_async_config() -> None:
-    global _default_cfg
-    with _default_cfg_lock:
-        _default_cfg = None
+    _default_cfgs.pop()
 
 
 def _next_round_tag(session: str) -> int:
     with _tags_lock:
-        tag = _driver_round_tags.get(session, 0)
-        _driver_round_tags[session] = tag + 1
+        tags = _driver_round_tags.get()
+        tag = tags.get(session, 0)
+        tags[session] = tag + 1
         return tag
 
 
@@ -1002,7 +1006,7 @@ def async_round(
             session, cfg_dict, serve_name
         )
     with _tags_lock:
-        _last_rounds[session] = {
+        _last_rounds.get()[session] = {
             "objs": dict(objs),
             "round_tag": int(round_tag),
             "weights": None if weights is None else dict(weights),
@@ -1048,7 +1052,7 @@ def async_rebuild(
     every party of the remembered round). Every driver must make the
     identical call."""
     with _tags_lock:
-        last = _last_rounds.get(session)
+        last = _last_rounds.get().get(session)
     if last is None:
         raise RuntimeError(
             f"async_rebuild({session!r}): no prior async_round to re-offer "

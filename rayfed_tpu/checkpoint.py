@@ -93,28 +93,23 @@ class CheckpointConfig:
         return dataclasses.asdict(self)
 
 
-_cfg_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (default checkpoint config; reset_default_checkpoint_config() at shutdown)
-_default_cfg: Optional[CheckpointConfig] = None  # fedlint: disable=global-mutable-singleton (default checkpoint config; reset_default_checkpoint_config() at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_default_cfgs: "JobScoped[CheckpointConfig]" = JobScoped("checkpoint.default")
 
 
 def set_default_checkpoint_config(data: Optional[Dict[str, Any]]) -> None:
     """Validate and install ``config['checkpoint']`` (called by
     ``fed.init``; raises on unknown keys so a typo rejects init)."""
-    global _default_cfg
-    cfg = CheckpointConfig.from_dict(data)
-    with _cfg_lock:
-        _default_cfg = cfg
+    _default_cfgs.set(CheckpointConfig.from_dict(data))
 
 
 def get_default_checkpoint_config() -> CheckpointConfig:
-    with _cfg_lock:
-        return _default_cfg or CheckpointConfig()
+    return _default_cfgs.peek() or CheckpointConfig()
 
 
 def reset_default_checkpoint_config() -> None:
-    global _default_cfg
-    with _cfg_lock:
-        _default_cfg = None
+    _default_cfgs.pop()
 
 
 def _checkpointer():
@@ -267,13 +262,12 @@ def save_job_state(
         ckpt.wait_until_finished()
 
     with async_rounds._sessions_lock:
-        session_names = list(async_rounds._sessions)
+        session_map = dict(async_rounds._sessions.get())
     sessions = {
-        name: async_rounds._sessions[name].export_state()
-        for name in session_names
+        name: agg.export_state() for name, agg in session_map.items()
     }
     with async_rounds._tags_lock:
-        round_tags = dict(async_rounds._driver_round_tags)
+        round_tags = dict(async_rounds._driver_round_tags.get())
     membership = get_membership_manager()
     privacy = get_privacy_manager()
     control = {
@@ -361,7 +355,7 @@ def restore_job_state(
             )
             agg.adopt_state(state)
         with async_rounds._tags_lock:
-            async_rounds._driver_round_tags.update(
+            async_rounds._driver_round_tags.get().update(
                 control.get("round_tags") or {}
             )
         membership = get_membership_manager()

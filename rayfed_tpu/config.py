@@ -64,33 +64,46 @@ class JobConfig:
         return self._data.get(constants.KEY_OF_CROSS_SILO_COMM_CONFIG_DICT, {})
 
 
-# Module-level lazy caches (ref fed/config.py:46-75).
-_cluster_config: Optional[ClusterConfig] = None  # fedlint: disable=global-mutable-singleton (config cache; reset_config_cache() at shutdown)
-_job_config: Optional[JobConfig] = None  # fedlint: disable=global-mutable-singleton (config cache; reset_config_cache() at shutdown)
+# Lazy caches keyed per job (ref fed/config.py:46-75 held one slot; two
+# concurrent fed.init jobs must each cache their own wire-stored config).
+_cluster_configs: Dict[str, ClusterConfig] = {}  # fedlint: disable=global-mutable-singleton (per-job config cache; reset_config_cache() at shutdown)
+_job_configs: Dict[str, JobConfig] = {}  # fedlint: disable=global-mutable-singleton (per-job config cache; reset_config_cache() at shutdown)
 
 
 def get_cluster_config(job_name: str) -> Optional[ClusterConfig]:
-    global _cluster_config
-    if _cluster_config is None:
+    cached = _cluster_configs.get(job_name)
+    if cached is None:
         raw = internal_kv.kv_get(job_name, constants.KEY_OF_CLUSTER_CONFIG)
         if raw is None:
             return None
-        _cluster_config = ClusterConfig(raw)
-    return _cluster_config
+        cached = ClusterConfig(raw)
+        _cluster_configs[job_name] = cached
+    return cached
 
 
 def get_job_config(job_name: str) -> JobConfig:
-    global _job_config
-    if _job_config is None:
-        raw = internal_kv.kv_get(job_name, constants.KEY_OF_JOB_CONFIG)
-        _job_config = JobConfig(raw)
-    return _job_config
+    cached = _job_configs.get(job_name)
+    if cached is None:
+        cached = JobConfig(
+            internal_kv.kv_get(job_name, constants.KEY_OF_JOB_CONFIG)
+        )
+        _job_configs[job_name] = cached
+    return cached
 
 
-def reset_config_cache() -> None:
-    global _cluster_config, _job_config
-    _cluster_config = None
-    _job_config = None
+def reset_config_cache(job_name: Optional[str] = None) -> None:
+    """Drop cached config — the current job's entries (resolved through
+    the tenancy plane) or, with no resolvable job, everything."""
+    if job_name is None:
+        from rayfed_tpu.tenancy.context import current_job
+
+        job_name = current_job()
+    if job_name is None:
+        _cluster_configs.clear()
+        _job_configs.clear()
+    else:
+        _cluster_configs.pop(job_name, None)
+        _job_configs.pop(job_name, None)
 
 
 # Receive-path payload cap applied when messages_max_size_in_bytes is

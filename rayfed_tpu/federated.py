@@ -146,19 +146,24 @@ def _secagg_reduce(op, parties, domain, round_index, weights, *envelopes):
 # the same arguments (the multi-controller contract), so every driver —
 # and therefore every party's masking task — derives the same round
 # index without any extra coordination.
-_secure_round_counters: Dict[str, int] = {}  # fedlint: disable=global-mutable-singleton (secure-round counters; dropped with the privacy plane at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_secure_round_counters: JobScoped = JobScoped(
+    "federated.secure_rounds", default_factory=dict
+)
 
 SECURE_SYNC_DOMAIN = "fedagg"
 
 
 def _next_secure_round(domain: str) -> int:
-    rnd = _secure_round_counters.get(domain, 0)
-    _secure_round_counters[domain] = rnd + 1
+    counters = _secure_round_counters.get()
+    rnd = counters.get(domain, 0)
+    counters[domain] = rnd + 1
     return rnd
 
 
 def _reset_secure_rounds() -> None:
-    _secure_round_counters.clear()
+    _secure_round_counters.pop()
 
 
 def _secure_sync_aggregate(plan, objs, op, weights, publish_to):

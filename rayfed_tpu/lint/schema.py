@@ -79,6 +79,11 @@ TELEMETRY_FIELDS = frozenset({
 
 CHECKPOINT_FIELDS = frozenset({"base_dir", "keep"})
 
+TENANCY_FIELDS = frozenset({
+    "executor_quota", "fair_window_mb", "kv_block_quota", "max_wait_ms",
+    "shm_ring_quota_mb", "weight",
+})
+
 LIVENESS_FIELDS = frozenset({
     "dead_after", "interval_ms", "suspect_after", "timeout_ms",
 })
@@ -114,7 +119,7 @@ TOP_LEVEL_KEYS = frozenset({
     "aggregation", "barrier_on_initializing", "checkpoint", "collective",
     "cross_silo_comm", "jax_distributed", "kv_store", "membership",
     "party_mesh", "privacy", "resilience", "serving", "telemetry",
-    "transport",
+    "tenancy", "transport",
 })
 
 #: section name -> allowed keys in a literal dict value.
@@ -134,6 +139,7 @@ SECTION_KEYS: Dict[str, FrozenSet[str]] = {
     "resilience": RESILIENCE_SECTION_KEYS,
     "serving": SERVING_FIELDS,
     "telemetry": TELEMETRY_FIELDS,
+    "tenancy": TENANCY_FIELDS,
 }
 
 #: (section, key) -> schema for a nested literal dict value.
@@ -164,6 +170,7 @@ CONFIG_CLASS_FIELDS: Dict[str, FrozenSet[str]] = {
     "CheckpointConfig": CHECKPOINT_FIELDS,
     "LivenessConfig": LIVENESS_FIELDS,
     "FailoverConfig": FAILOVER_FIELDS,
+    "TenancyConfig": TENANCY_FIELDS,
 }
 
 

@@ -1019,31 +1019,35 @@ def join_handshake(
     return manager, accept.get("bootstrap")
 
 
-# -- module singleton wired by fed.init / fed.join ---------------------
+# -- per-job manager slot wired by fed.init / fed.join -----------------
 
-_manager: Optional[MembershipManager] = None  # fedlint: disable=global-mutable-singleton (manager singleton; clear_membership_manager() at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_managers: "JobScoped[MembershipManager]" = JobScoped("membership.manager")
 
 
 def set_membership_manager(manager: Optional[MembershipManager]) -> None:
-    global _manager
-    _manager = manager
+    if manager is None:
+        _managers.pop()
+    else:
+        _managers.set(manager)
 
 
 def get_membership_manager() -> Optional[MembershipManager]:
-    return _manager
+    return _managers.peek()
 
 
 def clear_membership_manager() -> None:
-    global _manager
-    if _manager is not None:
+    manager = _managers.pop()
+    if manager is not None:
         try:
-            _manager.uninstall()
+            manager.uninstall()
         except Exception:  # noqa: BLE001 - teardown best-effort
             logger.warning("membership uninstall failed", exc_info=True)
-    _manager = None
 
 
 def current_epoch_or_none() -> Optional[int]:
     """The installed manager's epoch, or None on membership-free jobs —
     the stamp the async plane attaches to offers."""
-    return None if _manager is None else _manager.current_epoch()
+    manager = _managers.peek()
+    return None if manager is None else manager.current_epoch()

@@ -454,13 +454,13 @@ class PrivacyManager:
 # Process singleton + install/uninstall (fed.init / fed.shutdown)
 # ---------------------------------------------------------------------------
 
-_manager_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (privacy-plane singleton; uninstall_privacy() drops it at shutdown)
-_manager: Optional[PrivacyManager] = None  # fedlint: disable=global-mutable-singleton (privacy-plane singleton; uninstall_privacy() drops it at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_managers: "JobScoped[PrivacyManager]" = JobScoped("privacy.manager")
 
 
 def get_privacy_manager() -> Optional[PrivacyManager]:
-    with _manager_lock:
-        return _manager
+    return _managers.peek()
 
 
 def require_privacy_manager(what: str) -> PrivacyManager:
@@ -474,9 +474,10 @@ def require_privacy_manager(what: str) -> PrivacyManager:
 
 
 def set_privacy_manager(mgr: Optional[PrivacyManager]) -> None:
-    global _manager
-    with _manager_lock:
-        _manager = mgr
+    if mgr is None:
+        _managers.pop()
+    else:
+        _managers.set(mgr)
 
 
 def install_privacy(

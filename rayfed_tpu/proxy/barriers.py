@@ -66,13 +66,16 @@ def _reject_reserved_seq_ids(upstream_seq_id, downstream_seq_id) -> None:
         )
 
 
-# "Current" proxies used by module-level send/recv, plus a name-keyed
-# registry so several jobs' proxies can coexist in one process
-# (ref ``fed/proxy/barriers.py:31-85``: job-suffixed actor names when
-# ``use_global_proxy`` is False).
-_sender_proxy: Optional[SenderProxy] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
-_receiver_proxy: Optional[ReceiverProxy] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
-_proxy_registry: Dict[str, object] = {}  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
+# "Current" proxies used by module-level send/recv — one slot per job
+# (tenancy plane), so two concurrent fed.init jobs each resolve their own
+# transport pair — plus a name-keyed registry so several jobs' proxies
+# can coexist addressably (ref ``fed/proxy/barriers.py:31-85``:
+# job-suffixed actor names when ``use_global_proxy`` is False).
+from rayfed_tpu.tenancy.context import JobScoped
+
+_sender_proxies: JobScoped = JobScoped("barriers.sender_proxy")
+_receiver_proxies: JobScoped = JobScoped("barriers.receiver_proxy")
+_proxy_registry: Dict[str, object] = {}  # fedlint: disable=global-mutable-singleton (name-keyed proxy registry shared across jobs; stop_proxies() tears entries down at shutdown)
 
 _SENDER_NAME = "SenderProxy"
 _RECEIVER_NAME = "ReceiverProxy"
@@ -103,11 +106,11 @@ def get_registered_proxy(name: str):
 
 
 def sender_proxy() -> Optional[SenderProxy]:
-    return _sender_proxy
+    return _sender_proxies.peek()
 
 
 def receiver_proxy() -> Optional[ReceiverProxy]:
-    return _receiver_proxy
+    return _receiver_proxies.peek()
 
 
 # Epoch stamp for the seq-id space (elastic membership,
@@ -121,21 +124,19 @@ def receiver_proxy() -> Optional[ReceiverProxy]:
 # probe, the "mbr:*" membership namespace, resent error envelopes) pass
 # through unchanged, as does everything on membership-free jobs (no fn
 # registered = no behavior change).
-_seq_epoch_fn: Optional[Callable[[], Optional[int]]] = None  # fedlint: disable=global-mutable-singleton (per-job proxy handles; stop_proxies() tears them down at shutdown)
+_seq_epoch_fns: JobScoped = JobScoped("barriers.seq_epoch_fn")
 
 
 def set_seq_epoch_fn(fn: Callable[[], Optional[int]]) -> None:
-    global _seq_epoch_fn
-    _seq_epoch_fn = fn
+    _seq_epoch_fns.set(fn)
 
 
 def clear_seq_epoch_fn() -> None:
-    global _seq_epoch_fn
-    _seq_epoch_fn = None
+    _seq_epoch_fns.pop()
 
 
 def _stamp_epoch(seq_id):
-    fn = _seq_epoch_fn
+    fn = _seq_epoch_fns.peek()
     if fn is None or not isinstance(seq_id, int):
         return seq_id
     epoch = fn()
@@ -150,9 +151,10 @@ def admit_peer(party: str, address: str) -> None:
     ``_addresses`` map on first send, so admission is a dictionary
     update — the injector wrapper delegates attribute access to the
     wrapped proxy, so this reaches the real map through it."""
-    if _sender_proxy is None:
+    sp = _sender_proxies.peek()
+    if sp is None:
         return
-    addrs = getattr(_sender_proxy, "_addresses", None)
+    addrs = getattr(sp, "_addresses", None)
     if isinstance(addrs, dict):
         addrs[party] = address
 
@@ -161,12 +163,13 @@ def forget_peer(party: str) -> None:
     """Remove an evicted destination from the CURRENT sender proxy: drop
     its address (new sends fail fast instead of dialing a corpse) and
     close its per-destination worker if the transport keeps one."""
-    if _sender_proxy is None:
+    sp = _sender_proxies.peek()
+    if sp is None:
         return
-    addrs = getattr(_sender_proxy, "_addresses", None)
+    addrs = getattr(sp, "_addresses", None)
     if isinstance(addrs, dict):
         addrs.pop(party, None)
-    workers = getattr(_sender_proxy, "_workers", None)
+    workers = getattr(sp, "_workers", None)
     if isinstance(workers, dict):
         worker = workers.pop(party, None)
         if worker is not None:
@@ -189,9 +192,10 @@ def cancel_peer_inflight(party: str) -> int:
     the same getattr delegation ``forget_peer`` uses (the injector
     wrapper delegates attribute access); transports without per-dest
     workers or an shm lane are a no-op. Returns chunks reclaimed."""
-    if _sender_proxy is None:
+    sp = _sender_proxies.peek()
+    if sp is None:
         return 0
-    workers = getattr(_sender_proxy, "_workers", None)
+    workers = getattr(sp, "_workers", None)
     if not isinstance(workers, dict):
         return 0
     worker = workers.get(party)
@@ -222,9 +226,8 @@ def swap_sender_proxy(new_proxy) -> None:
     never leaves a stale entry behind. Note a SenderReceiverProxy is
     registered (and stopped) once but swapped only on its sender role —
     the receiver half keeps pointing at the inner object."""
-    global _sender_proxy
-    old = _sender_proxy
-    _sender_proxy = new_proxy
+    old = _sender_proxies.peek()
+    _sender_proxies.set(new_proxy)
     if old is None:
         return
     for name, obj in list(_proxy_registry.items()):
@@ -240,8 +243,9 @@ def send_ping(dest_party: str) -> Future:
     ``ping_others`` init barrier and the liveness monitor's heartbeats —
     one probe format, one code path, and it rides the (possibly
     injector-wrapped) data lane so probes see the same faults data does."""
-    assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
-    return _sender_proxy.send(dest_party, PING_SEQ_ID, PING_SEQ_ID, PING_SEQ_ID)
+    sp = _sender_proxies.peek()
+    assert sp is not None, "sender proxy not started; call fed.init()"
+    return sp.send(dest_party, PING_SEQ_ID, PING_SEQ_ID, PING_SEQ_ID)
 
 
 def _default_transport_classes(transport: str):
@@ -263,16 +267,14 @@ def start_receiver_proxy(
     """Start + readiness-check the receiver (ref ``barriers.py:248-281``:
     init blocks until the server bound its port, and a bind failure is an
     AssertionError — pinned by ``fed/tests/test_listening_address.py``)."""
-    global _receiver_proxy
-    _receiver_proxy = proxy_cls(
+    proxy = proxy_cls(
         addresses[party], party, job_name, tls_config, proxy_config
     )
-    _receiver_proxy.start()
-    ok, err = _receiver_proxy.is_ready(timeout=ready_timeout_s)
+    proxy.start()
+    ok, err = proxy.is_ready(timeout=ready_timeout_s)
     assert ok, err
-    _proxy_registry[receiver_proxy_name(job_name, use_global_proxy)] = (
-        _receiver_proxy
-    )
+    _receiver_proxies.set(proxy)
+    _proxy_registry[receiver_proxy_name(job_name, use_global_proxy)] = proxy
     logger.info("Receiver proxy ready on %s.", addresses[party])
 
 
@@ -285,12 +287,10 @@ def start_sender_proxy(
     proxy_config: Optional[Dict] = None,
     use_global_proxy: bool = True,
 ) -> None:
-    global _sender_proxy
-    _sender_proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
-    _sender_proxy.start()
-    _proxy_registry[sender_proxy_name(job_name, use_global_proxy)] = (
-        _sender_proxy
-    )
+    proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
+    proxy.start()
+    _sender_proxies.set(proxy)
+    _proxy_registry[sender_proxy_name(job_name, use_global_proxy)] = proxy
     logger.info("Sender proxy started.")
 
 
@@ -307,33 +307,45 @@ def start_sender_receiver_proxy(
     """Start one object serving both directions on the party's single
     advertised port (ref ``barriers.py:415-459``). It registers under ONE
     name and is installed as both the current sender and receiver."""
-    global _sender_proxy, _receiver_proxy
     proxy = proxy_cls(addresses, party, job_name, tls_config, proxy_config)
     proxy.start()
     ok, err = proxy.is_ready(timeout=ready_timeout_s)
     assert ok, err
-    _sender_proxy = proxy
-    _receiver_proxy = proxy
+    _sender_proxies.set(proxy)
+    _receiver_proxies.set(proxy)
     _proxy_registry[
         proxy_name("sender_receiver", job_name, use_global_proxy)
     ] = proxy
     logger.info("Sender-receiver proxy ready on %s.", addresses[party])
 
 
+def _pop_proxy_slot(scoped: JobScoped, job_name: Optional[str]):
+    """Pop the job's slot, falling back to the current thread's resolved
+    slot — proxies started before fed.init registered a context live
+    under the context-free slot, and the historical contract is that
+    stop_proxies always stops the *current* pair."""
+    if job_name is not None:
+        sentinel = object()
+        value = scoped.pop(job=job_name, default=sentinel)
+        if value is not sentinel:
+            return value
+    return scoped.pop()
+
+
 def stop_proxies(job_name: Optional[str] = None) -> None:
-    """Stop the current proxies; with ``job_name``, also drop that job's
+    """Stop the job's proxies; with ``job_name``, also drop that job's
     registry entries (global-named entries are dropped when they point at
     the stopped objects)."""
-    global _sender_proxy, _receiver_proxy
     stopped = set()
-    if _sender_proxy is not None:
-        _sender_proxy.stop()
-        stopped.add(id(_sender_proxy))
-        _sender_proxy = None
-    if _receiver_proxy is not None:
-        _receiver_proxy.stop()
-        stopped.add(id(_receiver_proxy))
-        _receiver_proxy = None
+    sp = _pop_proxy_slot(_sender_proxies, job_name)
+    if sp is not None:
+        sp.stop()
+        stopped.add(id(sp))
+    rp = _pop_proxy_slot(_receiver_proxies, job_name)
+    if rp is not None:
+        if id(rp) not in stopped:
+            rp.stop()
+            stopped.add(id(rp))
     job_names = (
         set()
         if job_name is None
@@ -378,7 +390,7 @@ def send(
     ):
         # Probed pre-stamp: the invariant lives in the integer seq space,
         # keyed per epoch (error envelopes reuse old ids by design).
-        fn = _seq_epoch_fn
+        fn = _seq_epoch_fns.peek()
         sanitize.probe_send_seq(
             dest_party, downstream_seq_id, fn() if fn is not None else None
         )
@@ -391,9 +403,10 @@ def send(
         done: Future = Future()
         done.set_result(True)
         return done
-    assert _sender_proxy is not None, "sender proxy not started; call fed.init()"
+    sp = _sender_proxies.peek()
+    assert sp is not None, "sender proxy not started; call fed.init()"
     data = _capture_for_send(dest_party, data)
-    fut = _sender_proxy.send(
+    fut = sp.send(
         dest_party, data, upstream_seq_id, downstream_seq_id, is_error=is_error
     )
     if ctx is not None:
@@ -513,7 +526,7 @@ def _capture_for_send(dest_party: str, data):
     socket anyway (mixed trees, numpy leaves) are captured as usual."""
     dma_lane = False
     try:
-        cfg = _sender_proxy.get_proxy_config(dest_party)
+        cfg = _sender_proxies.peek().get_proxy_config(dest_party)
         dma_lane = lanes.dma_enabled(cfg)
     except Exception:  # noqa: BLE001 - proxies without per-dest config
         pass
@@ -657,8 +670,9 @@ def recv(party: str, src_party: str, upstream_seq_id, curr_seq_id) -> Future:
         ).start()
         return out
 
-    assert _receiver_proxy is not None, "receiver proxy not started; call fed.init()"
-    raw = _receiver_proxy.get_data(src_party, upstream_seq_id, curr_seq_id)
+    rp = _receiver_proxies.peek()
+    assert rp is not None, "receiver proxy not started; call fed.init()"
+    raw = rp.get_data(src_party, upstream_seq_id, curr_seq_id)
     out: Future = Future()
     relay = _party_relay_client()
     job_name = ctx.get_job_name() if ctx is not None else ""
@@ -748,7 +762,7 @@ def ping_others(
     legitimately run without ``barrier_on_initializing`` — so after
     ``_MUTUAL_GRACE_CYCLES`` extra cycles the mutual wait yields with a
     log instead of blocking forever."""
-    assert _sender_proxy is not None
+    assert _sender_proxies.peek() is not None
     others = {p for p in addresses if p != self_party}
     reached: set = set()
     pending: Dict[str, Future] = {}
@@ -756,10 +770,8 @@ def ping_others(
     def _mutually_ready() -> Optional[set]:
         """None once mutual contact is certain (or unknowable); else the
         unseen peers."""
-        info = (
-            _receiver_proxy.ping_sources()
-            if _receiver_proxy is not None else None
-        )
+        rp = _receiver_proxies.peek()
+        info = rp.ping_sources() if rp is not None else None
         if info is None:
             # Backend's wire cannot attribute pings (e.g. the reference-
             # compatible gRPC wire has no src field): skip the mutual

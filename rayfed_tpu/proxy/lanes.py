@@ -70,6 +70,7 @@ import msgpack
 from rayfed_tpu import sanitize
 from rayfed_tpu._private.constants import (
     CODE_INTERNAL_ERROR,
+    CODE_JOB_MISMATCH,
     CODE_SHM_UNAVAILABLE,
 )
 from rayfed_tpu.config import LANE_TIERS
@@ -359,6 +360,14 @@ def _repromotion_counter():
         "Successful lane re-promotions after a demotion (health probe "
         "ACKed), by the lane promoted back to.",
         labels=("lane",),
+    )
+
+
+def _tenant_bleed_counter():
+    return telemetry_metrics.get_registry().counter(
+        "fed_tenant_shm_bleed_rejections_total",
+        "Shm adoptions rejected because the chunk's job tag disagreed "
+        "with the descriptor/frame job (cross-tenant delivery blocked).",
     )
 
 
@@ -694,6 +703,61 @@ def ring_name(job: str, src: str, dest: str) -> str:
 # parts never enter the ring.
 _SHM_KINDS = ("tree", "mp", "pickle")
 
+# --------------------------------------------------------------------------
+# Tenancy: per-chunk job tag + weighted-fair admission
+# --------------------------------------------------------------------------
+
+#: Every shm chunk carries a fixed-size job-tag block as its FIRST 64
+#: payload bytes (the native ring owns the real chunk header, so the tag
+#: rides inside the payload; 64 bytes keeps the true payload 64-byte
+#: aligned for zero-copy decode). Layout: magic "FJT1", 1-byte tag
+#: length, up to 56 job-name bytes, zero pad.
+JOB_TAG_LEN = 64
+_JOB_TAG_MAGIC = b"FJT1"
+_JOB_TAG_MAX = 56
+
+
+def encode_job_tag(job: Optional[str]) -> bytes:
+    raw = (job or "").encode("utf-8")[:_JOB_TAG_MAX]
+    block = _JOB_TAG_MAGIC + bytes([len(raw)]) + raw
+    return block + b"\x00" * (JOB_TAG_LEN - len(block))
+
+
+def decode_job_tag(block) -> Optional[str]:
+    """The tagged job name, or None when the block is not a job tag."""
+    block = bytes(memoryview(block)[:JOB_TAG_LEN])
+    if len(block) < JOB_TAG_LEN or block[:4] != _JOB_TAG_MAGIC:
+        return None
+    n = block[4]
+    if n > _JOB_TAG_MAX:
+        return None
+    return block[5:5 + n].decode("utf-8", "replace")
+
+
+def job_tag_matches(tag: Optional[str], job: Optional[str]) -> bool:
+    """Compare a decoded tag against a job name under the tag's
+    truncation (job names longer than 56 UTF-8 bytes compare by
+    prefix)."""
+    if tag is None or job is None:
+        return False
+    return tag.encode("utf-8") == job.encode("utf-8")[:_JOB_TAG_MAX]
+
+
+def qos_admit(job: Optional[str], payload_len: int,
+              small_threshold: int) -> float:
+    """Weighted-fair admission for one outbound frame (the lanes-level
+    entry point into the tenancy scheduler). Frames below the sender's
+    small-message threshold — serving requests, control traffic, error
+    envelopes — are ``inline`` class and never wait; bulk frames wait
+    (bounded) while this tenant is over its fair share. Returns seconds
+    waited. MUST NOT be called on a reactor thread (it can block)."""
+    from rayfed_tpu.tenancy import qos
+
+    tc = qos.TC_BULK if payload_len >= max(1, small_threshold) else (
+        qos.TC_INLINE
+    )
+    return qos.get_scheduler().admit(job, payload_len, tc)
+
 
 class ShmSender:
     """Owns the outbound shm ring for one destination.
@@ -735,6 +799,7 @@ class ShmSender:
             / 1000.0
         )
         self._name = ring_name(job, src, dest)
+        self._job = job
         self._dest = dest
         self._ring = None
         self._broken = False
@@ -742,7 +807,17 @@ class ShmSender:
         self._retry_at: Optional[float] = None
         self._probing = False
         self._outstanding: set = set()
+        # Tenancy: bytes charged against the job's shm_ring_quota_mb per
+        # outstanding offset, released when the chunk leaves our hands.
+        self._charges: Dict[int, int] = {}
         self._lock = threading.Lock()
+
+    def _release_charge_locked(self, off: int) -> None:
+        charged = self._charges.pop(off, 0)
+        if charged:
+            from rayfed_tpu.tenancy import qos
+
+            qos.get_ledger().release(self._job, "shm_ring_bytes", charged)
 
     @property
     def broken(self) -> bool:
@@ -788,11 +863,18 @@ class ShmSender:
             self._probing = True
             return True
 
-    def push(self, buffers, payload_len: int) -> Optional[Tuple[str, int]]:
-        """Copy the frame's buffers into the ring. Returns (ring_name,
-        offset) for the descriptor frame, or None to fall back. Waits up
-        to shm_push_timeout_ms for receivers to release space — the ring
-        throttles, the socket lane is the pressure valve."""
+    def push(self, buffers, payload_len: int) -> Optional[Tuple[str, int, int]]:
+        """Copy the frame's buffers into the ring, job-tagged. Returns
+        (ring_name, offset, stored_len) for the descriptor frame — where
+        stored_len = payload_len + the 64-byte job tag the receiver
+        validates and strips — or None to fall back. Waits up to
+        shm_push_timeout_ms for receivers to release space — the ring
+        throttles, the socket lane is the pressure valve. Raises
+        :class:`TenantQuotaExceeded` when the push would take the job
+        over its shm_ring_quota_mb (loud, never a silent fallback)."""
+        from rayfed_tpu.tenancy import qos
+
+        stored_len = payload_len + JOB_TAG_LEN
         with self._lock:
             if self._broken and not self._probing:
                 return None
@@ -806,32 +888,47 @@ class ShmSender:
                     )
                     self._mark_broken_locked()
                     return None
+            # Quota check-and-charge BEFORE the bytes land; a breach
+            # raises through to the caller (TenantQuotaExceeded).
+            qos.get_ledger().charge(
+                self._job, "shm_ring_bytes", stored_len
+            )
+            tagged = [encode_job_tag(self._job)] + list(buffers)
             deadline = time.monotonic() + self._timeout_s
             while True:
                 try:
-                    off = self._ring.push(buffers)
+                    off = self._ring.push(tagged)
                 except Exception as e:
                     logger.warning(
                         "shm push to %s failed (%s); falling back",
                         self._dest, e,
                     )
-                    return None
+                    off = None
+                    break
                 if off is not None:
-                    self._outstanding.add(off)
-                    try:
-                        used, _cap = self._ring.occupancy()
-                        _ring_occupancy_gauge().set(float(used))
-                    except Exception:  # noqa: BLE001 - telemetry only
-                        pass
-                    return (self._name, off)
+                    break
                 if time.monotonic() >= deadline:
-                    return None
+                    break
                 time.sleep(0.001)
+            if off is None:
+                qos.get_ledger().release(
+                    self._job, "shm_ring_bytes", stored_len
+                )
+                return None
+            self._outstanding.add(off)
+            self._charges[off] = stored_len
+            try:
+                used, _cap = self._ring.occupancy()
+                _ring_occupancy_gauge().set(float(used))
+            except Exception:  # noqa: BLE001 - telemetry only
+                pass
+            return (self._name, off, stored_len)
 
     def cancel(self, off: int) -> None:
         """Release a pushed chunk whose descriptor was never delivered."""
         with self._lock:
             self._outstanding.discard(off)
+            self._release_charge_locked(off)
             if self._ring is not None:
                 try:
                     self._ring.cancel(off)
@@ -843,6 +940,7 @@ class ShmSender:
         receiver now (its adopt/release governs the lifetime)."""
         with self._lock:
             self._outstanding.discard(off)
+            self._release_charge_locked(off)
 
     def cancel_peer_inflight(self) -> int:
         """Reclaim every outstanding chunk that is still INFLIGHT —
@@ -852,11 +950,14 @@ class ShmSender:
         double release. Returns the number of chunks reclaimed."""
         with self._lock:
             if self._ring is None:
+                for off in list(self._outstanding):
+                    self._release_charge_locked(off)
                 self._outstanding.clear()
                 return 0
             reclaimed = 0
             for off in list(self._outstanding):
                 self._outstanding.discard(off)
+                self._release_charge_locked(off)
                 state = None
                 chunk_state = getattr(self._ring, "chunk_state", None)
                 if chunk_state is not None:
@@ -917,23 +1018,28 @@ class ShmSender:
                 self._ring = None
             self._broken = True
             self._probing = False
+            for off in list(self._charges):
+                self._release_charge_locked(off)
             self._outstanding.clear()
 
 
 def encode_shm_descriptor(name: str, off: int, length: int,
-                          orig_header: Dict) -> bytes:
-    """The descriptor payload for an shm push: where the bytes live and
-    how to restore the original frame header on the receiver."""
-    return msgpack.packb(
-        {
-            "n": name,
-            "o": int(off),
-            "l": int(length),
-            "pk": orig_header.get("pkind"),
-            "pm": bytes(orig_header.get("pmeta", b"") or b""),
-        },
-        use_bin_type=True,
-    )
+                          orig_header: Dict,
+                          job: Optional[str] = None) -> bytes:
+    """The descriptor payload for an shm push: where the bytes live, how
+    to restore the original frame header on the receiver, and which
+    tenant owns the chunk (``j`` — cross-checked against the in-chunk
+    job tag and the frame header's job id at adoption)."""
+    desc = {
+        "n": name,
+        "o": int(off),
+        "l": int(length),
+        "pk": orig_header.get("pkind"),
+        "pm": bytes(orig_header.get("pmeta", b"") or b""),
+    }
+    if job is not None:
+        desc["j"] = job
+    return msgpack.packb(desc, use_bin_type=True)
 
 
 # --------------------------------------------------------------------------
@@ -1010,6 +1116,8 @@ class ShmAdopter:
                 return f"shm descriptor field {field!r} missing/not int"
         if not isinstance(desc.get("pk"), str):
             return "shm descriptor missing original payload kind"
+        if "j" in desc and not isinstance(desc["j"], str):
+            return "shm descriptor job tag is not a string"
         return None
 
     def offer(self, header: Dict, payload) -> Tuple[int, str]:
@@ -1036,6 +1144,27 @@ class ShmAdopter:
                 "sender falls back to the socket lane", desc.get("n"), e,
             )
             return CODE_SHM_UNAVAILABLE, f"cannot adopt shm chunk: {e}"
+        desc_job = desc.get("j")
+        if desc_job is not None:
+            # Tenancy: the chunk's first 64 bytes are the sender's job
+            # tag. All three ids — in-chunk tag, descriptor, frame
+            # header — must agree, or the chunk is another tenant's and
+            # adopting it would be a cross-job delivery.
+            tag = decode_job_tag(memoryview(buf)[:JOB_TAG_LEN])
+            header_job = header.get("job")
+            if not job_tag_matches(tag, desc_job) or (
+                header_job is not None and header_job != desc_job
+            ):
+                sanitize.probe_tenant_bleed(
+                    desc.get("n"), tag, desc_job, header_job
+                )
+                _tenant_bleed_counter().inc()
+                return (
+                    CODE_JOB_MISMATCH,
+                    f"shm chunk job tag {tag!r} does not match descriptor "
+                    f"job {desc_job!r} / frame job {header_job!r}",
+                )
+            buf = memoryview(buf)[JOB_TAG_LEN:]
         inner = dict(header)
         inner["pkind"] = desc["pk"]
         inner["pmeta"] = desc.get("pm", b"") or b""

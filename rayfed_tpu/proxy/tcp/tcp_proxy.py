@@ -78,6 +78,7 @@ from rayfed_tpu.resilience import inject as fault_inject
 from rayfed_tpu.resilience import linkhealth
 from rayfed_tpu.resilience.retry import Deadline, run_with_retry
 from rayfed_tpu.telemetry import metrics as telemetry_metrics
+from rayfed_tpu.tenancy.context import TenantQuotaExceeded
 
 logger = logging.getLogger(__name__)
 
@@ -261,6 +262,12 @@ class _DestWorker(threading.Thread):
         except BaseException as e:  # noqa: BLE001 - routed to drain
             out.set_exception(e)
             return
+        # Weighted-fair admission runs on the submitting/producer thread
+        # (never a reactor loop): a bulk push from this job waits here
+        # while a lighter co-tenant's inline traffic clears.
+        lanes.qos_admit(
+            self._proxy._job_name, payload_len, self._small_threshold
+        )
         self._attach_done_callbacks(
             out, on_done, payload_len, upstream_seq_id, downstream_seq_id
         )
@@ -348,14 +355,26 @@ class _DestWorker(threading.Thread):
         shm = self._shm
         if shm is None or not shm.eligible(header, payload_len):
             return False
-        pushed = shm.push(buffers, payload_len)
+        try:
+            pushed = shm.push(buffers, payload_len)
+        except TenantQuotaExceeded as e:
+            # A quota breach is a hard admission failure, never a silent
+            # fallback: riding the socket instead would let one tenant
+            # spend transport capacity its quota says it does not have.
+            if not out.done():
+                out.set_exception(e)
+            return True
         if pushed is None:
             # Ring saturated or create failed: this push rides the
             # socket; later pushes try the ring again unless broken.
             lanes.record_fallback("shm", "tcp")
             return False
-        name, off = pushed
-        desc = lanes.encode_shm_descriptor(name, off, payload_len, header)
+        # stored_len covers the in-payload job tag the adopter strips
+        # after verifying it against the descriptor's job field.
+        name, off, stored_len = pushed
+        desc = lanes.encode_shm_descriptor(
+            name, off, stored_len, header, job=self._proxy._job_name
+        )
         dheader = dict(header)
         dheader["pkind"] = "shm"
         dheader["pmeta"] = b""
@@ -522,6 +541,11 @@ class _DestWorker(threading.Thread):
             except BaseException as e:  # noqa: BLE001 - routed to drain
                 out.set_exception(e)
                 continue
+            # Same weighted-fair gate as the reactor path; this worker
+            # thread is exactly where a bulk frame should wait.
+            lanes.qos_admit(
+                self._proxy._job_name, payload_len, self._small_threshold
+            )
             self._attach_done_callbacks(
                 out, on_done, payload_len, upstream_seq_id,
                 downstream_seq_id,
@@ -632,6 +656,12 @@ class _DestWorker(threading.Thread):
             )
         except BaseException:  # noqa: BLE001 - worker path re-raises it
             return False
+        # Fast sends are inline-class by construction (bounded by the
+        # small threshold): admission never waits, it only accounts the
+        # tenant's bytes for the fairness ledger.
+        lanes.qos_admit(
+            self._proxy._job_name, payload_len, self._small_threshold
+        )
         self._attach_done_callbacks(
             out, on_done, payload_len, upstream_seq_id, downstream_seq_id
         )
@@ -917,6 +947,36 @@ class TcpSenderProxy(SenderProxy):
             reactor_mod.release_reactors()
 
 
+#: bind address -> the receiver that owns the live listener socket there.
+#: Concurrent jobs in one process share one listen address: the first
+#: receiver to bind becomes the owner, later ones piggyback by
+#: registering their offer chain under their job name and the owner's
+#: frame dispatch routes by the FTP1 header job id (unknown jobs still
+#: earn 417 from the owner's own rendezvous store).
+_shared_listeners: Dict[str, "TcpReceiverProxy"] = {}  # fedlint: disable=global-mutable-singleton (cross-job by design; reset_shared_listeners() clears it)
+_shared_listeners_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards the cross-job listener registry)
+
+
+def reset_shared_listeners() -> None:
+    """Reset hook (last-job shutdown): drop stale listener ownership
+    records. Live receivers deregister themselves in ``stop``; anything
+    left here belongs to a job that never shut down cleanly."""
+    with _shared_listeners_lock:
+        _shared_listeners.clear()
+
+
+def _register_piggyback(addr: str, receiver: "TcpReceiverProxy"):
+    """Attach ``receiver`` to the live listener owner at ``addr``.
+    Returns the owner, or None when nobody owns the address (the bind
+    failure was a real error, not multi-tenancy)."""
+    with _shared_listeners_lock:
+        owner = _shared_listeners.get(addr)
+        if owner is None or owner._stopping:
+            return None
+        owner._add_tenant(receiver)
+        return owner
+
+
 class TcpReceiverProxy(ReceiverProxy):
     def __init__(self, listen_addr, party, job_name, tls_config, proxy_config=None):
         super().__init__(listen_addr, party, job_name, tls_config, proxy_config)
@@ -947,7 +1007,15 @@ class TcpReceiverProxy(ReceiverProxy):
         # it, and a mismatch NACKs CODE_DATA_CORRUPT — the sender
         # requeues the frame for retransmit (proxy/tcp/checksum.py).
         self._crc_failures = 0
-        self._offer = self._verified_offer
+        # Frames reach this receiver through the tenant router: when this
+        # receiver owns a shared listener, co-tenant jobs' frames are
+        # forwarded to THEIR verified chains by header job id; everything
+        # else (including unknown jobs -> 417) runs the own-job chain.
+        self._offer = self._route_offer
+        self._tenant_lock = threading.Lock()
+        self._tenants: Dict[str, "TcpReceiverProxy"] = {}
+        self._job_stores: Dict[str, object] = {}
+        self._piggyback_host: Optional["TcpReceiverProxy"] = None
         self._listener: Optional[socket.socket] = None
         self._ready_result = None
         self._open_conns: set = set()
@@ -958,6 +1026,31 @@ class TcpReceiverProxy(ReceiverProxy):
         # are replaced by ServerConnection handlers on the shared loops.
         self._reactors = None
         self._next_reactor = 0
+
+    def _route_offer(self, header, payload) -> Tuple[int, str]:
+        """Shared-listener tenant dispatch: a frame whose header job id
+        names a piggybacked co-tenant runs that tenant's verified chain
+        (its own crc counter, shm adopter and rendezvous store). The
+        common single-job case short-circuits on the job compare; a frame
+        for a job nobody here serves falls through and earns the 417 from
+        this receiver's own store."""
+        job = header.get("job")
+        if job is not None and job != self._job_name:
+            with self._tenant_lock:
+                tenant_offer = self._job_stores.get(job)
+            if tenant_offer is not None:
+                return tenant_offer(header, payload)
+        return self._verified_offer(header, payload)
+
+    def _add_tenant(self, receiver: "TcpReceiverProxy") -> None:
+        with self._tenant_lock:
+            self._tenants[receiver._job_name] = receiver
+            self._job_stores[receiver._job_name] = receiver._verified_offer
+
+    def _remove_tenant(self, job_name: str) -> None:
+        with self._tenant_lock:
+            self._tenants.pop(job_name, None)
+            self._job_stores.pop(job_name, None)
 
     def _verified_offer(self, header, payload) -> Tuple[int, str]:
         ok = checksum.verify(header, payload)
@@ -997,11 +1090,27 @@ class TcpReceiverProxy(ReceiverProxy):
         try:
             self._bind_listener()
         except OSError as e:
+            # Multiplexing path: another job's receiver in THIS process
+            # already listens on the address — piggyback on its listener
+            # instead of failing. The owner routes inbound frames here by
+            # the FTP1 header job id.
+            host = _register_piggyback(self._listen_addr, self)
+            if host is not None:
+                self._piggyback_host = host
+                self._ready_result = (True, None)
+                logger.info(
+                    "receiver for job %r shares the listener at %s owned "
+                    "by job %r (multi-tenant transport multiplexing)",
+                    self._job_name, self._listen_addr, host._job_name,
+                )
+                return
             self._ready_result = (
                 False, f"failed to bind {self._listen_addr}: {e}"
             )
             return
         self._ready_result = (True, None)
+        with _shared_listeners_lock:
+            _shared_listeners[self._listen_addr] = self
         if _reactor_mode(self._config, self._tls_config):
             self._reactors = reactor_mod.acquire_reactors(
                 max(1, getattr(self._config, "num_reactors", 1))
@@ -1028,6 +1137,12 @@ class TcpReceiverProxy(ReceiverProxy):
 
     def stop(self) -> None:
         self._stopping = True
+        host = self._piggyback_host
+        if host is not None:
+            # Piggybacked tenant: just leave the owner's routing table;
+            # the listener belongs to the owner.
+            self._piggyback_host = None
+            host._remove_tenant(self._job_name)
         if self._listener is not None:
             try:
                 # shutdown() wakes the thread blocked in accept(); a bare
@@ -1041,6 +1156,13 @@ class TcpReceiverProxy(ReceiverProxy):
                 self._listener.close()
             except OSError:
                 pass
+        with _shared_listeners_lock:
+            if _shared_listeners.get(self._listen_addr) is self:
+                _shared_listeners.pop(self._listen_addr, None)
+        with self._tenant_lock:
+            tenants = [t for t in self._tenants.values() if not t._stopping]
+            self._tenants.clear()
+            self._job_stores.clear()
         with self._conn_lock:
             conns = list(self._open_conns)
         for c in conns:
@@ -1055,6 +1177,47 @@ class TcpReceiverProxy(ReceiverProxy):
         self._store.shutdown()
         # A burst of large frames must not pin pool memory past the job.
         sockio.trim_recv_pool()
+        # Listener handoff: the owner of a shared address is leaving while
+        # co-tenant jobs still serve — the first survivor re-binds the now
+        # free port and absorbs the rest (their chains re-register with
+        # the new owner). Senders ride their retry policy across the gap.
+        for tenant in tenants:
+            tenant._adopt_listener()
+
+    def _adopt_listener(self) -> None:
+        """Take over a shared listen address after its owner stopped:
+        bind it ourselves, or re-piggyback on whichever surviving tenant
+        won the race to bind first."""
+        if self._stopping:
+            return
+        self._piggyback_host = None
+        try:
+            self._bind_listener()
+        except OSError as e:
+            host = _register_piggyback(self._listen_addr, self)
+            if host is not None:
+                self._piggyback_host = host
+                return
+            logger.warning(
+                "job %r could not take over the shared listener at %s "
+                "after its owner stopped: %s", self._job_name,
+                self._listen_addr, e,
+            )
+            return
+        with _shared_listeners_lock:
+            _shared_listeners[self._listen_addr] = self
+        if (
+            _reactor_mode(self._config, self._tls_config)
+            and self._reactors is None
+        ):
+            self._reactors = reactor_mod.acquire_reactors(
+                max(1, getattr(self._config, "num_reactors", 1))
+            )
+        threading.Thread(
+            target=self._accept_loop,
+            name=f"fedtpu-recv-accept-{self._party}",
+            daemon=True,
+        ).start()
 
     # -- data path -------------------------------------------------------------
 

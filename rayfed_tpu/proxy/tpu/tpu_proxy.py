@@ -128,6 +128,12 @@ def _take_same_mesh(payload):
     return placed
 
 
+def clear_same_mesh() -> None:
+    """Reset hook: drop parked same-mesh references (last-job shutdown)."""
+    with _same_mesh_lock:
+        _same_mesh_table.clear()
+
+
 class TpuSenderProxy(TcpSenderProxy):
     """Sender side: identical wire behavior; arrays (jax or numpy) ride the
     zero-pickle tree encoding. Device→host staging happens in the encode

@@ -702,14 +702,17 @@ class InjectingSenderProxy:
 
 # -- install / uninstall at the barriers seam -------------------------
 
-_installed: Optional[InjectingSenderProxy] = None  # fedlint: disable=global-mutable-singleton (injector install flag; uninstall() clears it at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_installed_injectors: "JobScoped[InjectingSenderProxy]" = JobScoped(
+    "inject.installed"
+)
 
 
 def install(schedule: FaultSchedule, party: str) -> InjectingSenderProxy:
     """Wrap the current sender proxy (post-``fed.init`` proxy startup)
     in an injector. Idempotent per init: installing twice replaces the
     previous schedule rather than double-wrapping."""
-    global _installed
     from rayfed_tpu.proxy import barriers
 
     inner = barriers.sender_proxy()
@@ -718,7 +721,7 @@ def install(schedule: FaultSchedule, party: str) -> InjectingSenderProxy:
         inner = inner.inner
     injector = InjectingSenderProxy(inner, schedule, party)
     barriers.swap_sender_proxy(injector)
-    _installed = injector
+    _installed_injectors.set(injector)
     logger.info(
         "fault injection installed: seed=%d, %d rule(s)",
         schedule.seed, len(schedule.rules),
@@ -729,7 +732,6 @@ def install(schedule: FaultSchedule, party: str) -> InjectingSenderProxy:
 def uninstall() -> None:
     """Unwrap the injector, restoring the real sender proxy. The last
     trace stays readable via :func:`fault_trace` until the next install."""
-    global _installed
     from rayfed_tpu.proxy import barriers
 
     current = barriers.sender_proxy()
@@ -738,10 +740,11 @@ def uninstall() -> None:
 
 
 def get_injector() -> Optional[InjectingSenderProxy]:
-    return _installed
+    return _installed_injectors.peek()
 
 
 def fault_trace() -> List[Dict[str, Any]]:
     """The installed (or most recently installed) injector's data-frame
     fault trace, in send order; [] when injection was never enabled."""
-    return [] if _installed is None else _installed.fault_trace()
+    injector = _installed_injectors.peek()
+    return [] if injector is None else injector.fault_trace()

@@ -301,9 +301,11 @@ class LivenessMonitor:
         self._thread = None
 
 
-# -- module singleton wired by fed.init -------------------------------
+# -- per-job monitor slot wired by fed.init ---------------------------
 
-_monitor: Optional[LivenessMonitor] = None  # fedlint: disable=global-mutable-singleton (monitor singleton; stop_monitor() clears it at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_monitors: "JobScoped[LivenessMonitor]" = JobScoped("liveness.monitor")
 
 
 def start_monitor(
@@ -311,35 +313,37 @@ def start_monitor(
     config: Optional[LivenessConfig] = None,
     probe_fn: Optional[Callable[[str], Future]] = None,
 ) -> LivenessMonitor:
-    global _monitor
-    if _monitor is not None:
-        _monitor.stop()
-    _monitor = LivenessMonitor(peers, config, probe_fn)
-    _monitor.start()
-    return _monitor
+    old = _monitors.peek()
+    if old is not None:
+        old.stop()
+    monitor = LivenessMonitor(peers, config, probe_fn)
+    _monitors.set(monitor)
+    monitor.start()
+    return monitor
 
 
 def stop_monitor() -> None:
-    global _monitor
-    if _monitor is not None:
-        _monitor.stop()
-        _monitor = None
+    monitor = _monitors.pop()
+    if monitor is not None:
+        monitor.stop()
 
 
 def get_monitor() -> Optional[LivenessMonitor]:
-    return _monitor
+    return _monitors.peek()
 
 
 def liveness_view() -> Dict[str, str]:
-    """Current membership view, or {} when no monitor is running."""
-    return {} if _monitor is None else _monitor.view()
+    """Current job's membership view, or {} when no monitor runs."""
+    monitor = _monitors.peek()
+    return {} if monitor is None else monitor.view()
 
 
 def party_state(party: str) -> str:
     """A party's liveness state; ALIVE when no monitor is running (no
     evidence of death = optimistic default, matching the engine's
     behavior before this subsystem existed)."""
-    return ALIVE if _monitor is None else _monitor.state(party)
+    monitor = _monitors.peek()
+    return ALIVE if monitor is None else monitor.state(party)
 
 
 def state_weight(state: Optional[str], suspect_factor: float = 1.0) -> float:

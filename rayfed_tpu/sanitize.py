@@ -44,6 +44,11 @@ probe catalog (see ``docs/sanitizer.md`` for the contract):
     sender's clean stored buffers — the same frame key failing
     verification repeatedly means the retransmit path re-sends
     corrupted bytes.
+``tenant-bleed``
+    an shm chunk's in-payload job tag, its descriptor's job field and
+    the carrying frame's header job id must all agree at adoption — a
+    disagreement means one tenant's bytes were about to be delivered
+    into another tenant's rendezvous namespace.
 
 Every probe body begins with the enabled test, so the disabled cost is
 one module-global read per seam (the overhead contract in
@@ -276,6 +281,25 @@ def probe_crc_retransmit(key: Tuple, limit: int = 2) -> None:
         f"must carry the sender's clean stored buffers, so repeated "
         f"mismatches on one key mean the stored payload itself is "
         f"corrupted",
+    )
+
+
+def probe_tenant_bleed(
+    ring: object, tag: Optional[str], desc_job: Optional[str],
+    header_job: Optional[str],
+) -> None:
+    """``tenant-bleed``: the three job ids riding one shm delivery —
+    in-chunk tag, descriptor field, frame header — must agree. Called by
+    the adopter just before it NACKs the mismatched chunk (417); with
+    the sanitizer on, the NACK becomes a loud trip naming both
+    tenants."""
+    if not _enabled:
+        return
+    _trip(
+        "tenant-bleed",
+        f"shm ring {ring!r} chunk tagged for job {tag!r} offered with "
+        f"descriptor job {desc_job!r} and frame-header job {header_job!r}:"
+        f" a cross-tenant delivery was blocked at adoption",
     )
 
 

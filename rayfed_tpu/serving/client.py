@@ -193,13 +193,17 @@ def submit_request(handle: ServeHandle, prompt, **opts) -> FedObject:
 # Job-level default config (config['serving'] from fed.init), following
 # the topology.set_default pattern: every driver reads the same dict, so
 # every party builds the same engine.
-_default_serving_config: Optional[Dict[str, Any]] = None  # fedlint: disable=global-mutable-singleton (default serving config; reset to None at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_default_serving_configs: JobScoped = JobScoped("serving.default_config")
 
 
 def set_default_serving_config(d: Optional[Dict[str, Any]]) -> None:
-    global _default_serving_config
-    _default_serving_config = dict(d) if d else None
+    if d:
+        _default_serving_configs.set(dict(d))
+    else:
+        _default_serving_configs.pop()
 
 
 def get_default_serving_config() -> Optional[Dict[str, Any]]:
-    return _default_serving_config
+    return _default_serving_configs.peek()

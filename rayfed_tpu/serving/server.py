@@ -681,26 +681,39 @@ class InferenceServer:
         self._finish(req)
 
 
-# -- process-local server registry (one per serve() name) --------------------
+# -- per-job server registry (one per serve() name) --------------------------
 
-_registry_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (server registry; stop_all_servers() drains it at shutdown)
-_servers: Dict[str, InferenceServer] = {}  # fedlint: disable=global-mutable-singleton (server registry; stop_all_servers() drains it at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_registry_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards the per-job server registries)
+_servers: JobScoped = JobScoped("serving.servers", default_factory=dict)
 
 
 def register_server(server: InferenceServer) -> None:
+    from rayfed_tpu.tenancy.context import current_job
+    from rayfed_tpu.tenancy.qos import get_ledger
+
     with _registry_lock:
-        old = _servers.get(server.name)
+        registry = _servers.get()
+        old = registry.get(server.name)
         if old is not None and old is not server:
             raise ValueError(
                 f"a server named {server.name!r} is already registered; "
                 "stop it first or pick another name"
             )
-        _servers[server.name] = server
+        if old is not server:
+            # KV decode rows come out of a pooled accelerator budget:
+            # charge this tenant for the slots its engine pins. Raises
+            # TenantQuotaExceeded before the engine is registered.
+            job = current_job()
+            get_ledger().charge(job, "kv_blocks", server.pool.max_slots)
+            server._kv_ledger_charge = (job, server.pool.max_slots)
+        registry[server.name] = server
 
 
 def get_server(name: str = "default") -> InferenceServer:
     with _registry_lock:
-        server = _servers.get(name)
+        server = _servers.get().get(name)
     if server is None:
         raise RuntimeError(
             f"no serving engine named {name!r} on this party — "
@@ -709,18 +722,30 @@ def get_server(name: str = "default") -> InferenceServer:
     return server
 
 
+def _release_kv_charge(server: Optional[InferenceServer]) -> None:
+    charge = getattr(server, "_kv_ledger_charge", None)
+    if charge is None:
+        return
+    from rayfed_tpu.tenancy.qos import get_ledger
+
+    server._kv_ledger_charge = None
+    get_ledger().release(charge[0], "kv_blocks", charge[1])
+
+
 def unregister_server(name: str) -> None:
     with _registry_lock:
-        _servers.pop(name, None)
+        server = _servers.get().pop(name, None)
+    _release_kv_charge(server)
 
 
 def stop_all_servers(timeout: float = 10.0) -> None:
-    """Teardown hook for fed.shutdown(): stop every registered engine."""
+    """Teardown hook for fed.shutdown(): stop the current job's engines."""
     with _registry_lock:
-        servers = list(_servers.values())
-        _servers.clear()
+        registry = _servers.pop() or {}
+        servers = list(registry.values())
     for server in servers:
         try:
             server.stop(timeout)
         except Exception:  # noqa: BLE001 - teardown best-effort
             logger.exception("serving[%s]: stop failed", server.name)
+        _release_kv_charge(server)

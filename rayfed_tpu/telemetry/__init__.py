@@ -27,6 +27,11 @@ Wired from ``fed.init(config={"telemetry": {...}})``; see
 :class:`rayfed_tpu.telemetry.config.TelemetryConfig` for the knobs.
 This module stays import-light (rendezvous imports ``.metrics`` at
 module scope); the agent/collector machinery loads on :func:`start`.
+
+Tenancy: each job gets its own agent/collector/HTTP slot (JobScoped),
+so two concurrent ``fed.init`` jobs in one process run independent
+telemetry planes; cross-tenant series separation inside the shared
+metrics registry rides the ``fed_tenant_*{job=...}`` label dimension.
 """
 
 from __future__ import annotations
@@ -38,16 +43,29 @@ from typing import Dict, Optional
 
 from rayfed_tpu.telemetry import metrics  # noqa: F401 - re-export
 from rayfed_tpu.telemetry.config import TelemetryConfig
+from rayfed_tpu.tenancy.context import JobScoped
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_agent = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_collector = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_http = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_job_name: Optional[str] = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_party: Optional[str] = None  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
-_we_enabled_tracing = False  # fedlint: disable=global-mutable-singleton (telemetry plane is process-global by contract (docs/observability.md))
+_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (guards the per-job plane slots)
+_planes: JobScoped = JobScoped("telemetry.plane")
+
+
+class _Plane:
+    """One job's telemetry machinery (agent + optional collector/HTTP)."""
+
+    __slots__ = (
+        "agent", "collector", "http", "job_name", "party",
+        "we_enabled_tracing",
+    )
+
+    def __init__(self, job_name: str, party: str) -> None:
+        self.agent = None
+        self.collector = None
+        self.http = None
+        self.job_name = job_name
+        self.party = party
+        self.we_enabled_tracing = False
 
 
 def resolve_collector(cfg: TelemetryConfig, parties) -> str:
@@ -67,7 +85,6 @@ def start(
     """Start this party's telemetry plane: the push agent everywhere,
     plus the collector (and optional HTTP endpoint) when ``party`` is
     the collector party. Idempotent per init; re-entrant after stop()."""
-    global _agent, _collector, _http, _job_name, _party, _we_enabled_tracing
     from rayfed_tpu import tracing
     from rayfed_tpu.telemetry.agent import TelemetryAgent
     from rayfed_tpu.telemetry.collector import (
@@ -77,58 +94,57 @@ def start(
 
     with _lock:
         _stop_locked()
-        _job_name, _party = job_name, party
+        plane = _Plane(job_name, party)
         if cfg.enable_tracing and not tracing.is_enabled():
             tracing.enable()
-            _we_enabled_tracing = True
+            plane.we_enabled_tracing = True
         collector_party = resolve_collector(cfg, addresses or [party])
         if party == collector_party:
-            _collector = FleetCollector(job_name, party, cfg, addresses)
-            _collector.register()
+            plane.collector = FleetCollector(job_name, party, cfg, addresses)
+            plane.collector.register()
             if cfg.http_port is not None:
                 try:
-                    _http = CollectorHTTPServer(
-                        _collector, cfg.http_host, cfg.http_port
+                    plane.http = CollectorHTTPServer(
+                        plane.collector, cfg.http_host, cfg.http_port
                     )
-                    logger.info("telemetry endpoint at %s", _http.url)
+                    logger.info("telemetry endpoint at %s", plane.http.url)
                 except Exception:  # noqa: BLE001 - endpoint is optional
                     logger.warning(
                         "telemetry HTTP endpoint failed to start",
                         exc_info=True,
                     )
-                    _http = None
-        _agent = TelemetryAgent(
+                    plane.http = None
+        plane.agent = TelemetryAgent(
             party, job_name, collector_party, cfg,
-            local_collector=_collector,
+            local_collector=plane.collector,
         )
-        _agent.start()
+        _planes.set(plane)
+        plane.agent.start()
 
 
 def _stop_locked(flush: bool = False) -> None:
-    global _agent, _collector, _http, _we_enabled_tracing
-    if _agent is not None:
+    plane = _planes.pop()
+    if plane is None:
+        return
+    if plane.agent is not None:
         try:
-            _agent.stop(flush=flush)
+            plane.agent.stop(flush=flush)
         except Exception:  # noqa: BLE001 - best-effort teardown
             pass
-        _agent = None
-    if _http is not None:
+    if plane.http is not None:
         try:
-            _http.stop()
+            plane.http.stop()
         except Exception:  # noqa: BLE001 - best-effort teardown
             pass
-        _http = None
-    if _collector is not None:
+    if plane.collector is not None:
         try:
-            _collector.unregister()
+            plane.collector.unregister()
         except Exception:  # noqa: BLE001 - best-effort teardown
             pass
-        _collector = None
-    if _we_enabled_tracing:
+    if plane.we_enabled_tracing:
         from rayfed_tpu import tracing
 
         tracing.disable()
-        _we_enabled_tracing = False
 
 
 def stop(flush: bool = True) -> None:
@@ -137,25 +153,32 @@ def stop(flush: bool = True) -> None:
 
 
 def is_running() -> bool:
-    return _agent is not None
+    plane = _planes.peek()
+    return plane is not None and plane.agent is not None
 
 
 def get_agent():
-    return _agent
+    plane = _planes.peek()
+    return None if plane is None else plane.agent
 
 
 def get_collector():
-    return _collector
+    plane = _planes.peek()
+    return None if plane is None else plane.collector
 
 
 def http_url() -> Optional[str]:
-    return _http.url if _http is not None else None
+    plane = _planes.peek()
+    if plane is None or plane.http is None:
+        return None
+    return plane.http.url
 
 
 def telemetry_snapshot() -> dict:
     """The fleet view on the collector party; this party's local
     registry snapshot elsewhere (``fleet`` key tells which you got)."""
-    col = _collector
+    plane = _planes.peek()
+    col = None if plane is None else plane.collector
     if col is not None:
         view = col.fleet_view()
         url = http_url()
@@ -164,8 +187,8 @@ def telemetry_snapshot() -> dict:
         return view
     return {
         "fleet": False,
-        "job": _job_name,
-        "party": _party,
+        "job": None if plane is None else plane.job_name,
+        "party": None if plane is None else plane.party,
         "metrics": metrics.get_registry().snapshot(),
     }
 
@@ -173,7 +196,8 @@ def telemetry_snapshot() -> dict:
 def export_fleet_trace(path: Optional[str] = None) -> dict:
     """The collector's stitched cross-party trace. With ``path``, also
     written as JSON (``tools/trace_view.py --fleet`` input format)."""
-    col = _collector
+    plane = _planes.peek()
+    col = None if plane is None else plane.collector
     if col is None:
         raise RuntimeError(
             "export_fleet_trace() must run on the collector party "

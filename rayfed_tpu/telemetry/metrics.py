@@ -302,6 +302,25 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def zero(self) -> None:
+        """Zero every series in place, keeping every metric and child
+        registration — producers that captured child references at
+        import time stay wired (unlike a registry swap, which detaches
+        them)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            with m._lock:
+                children = list(m._children.values())
+            for child in children:
+                with child._lock:
+                    if isinstance(child, _HistChild):
+                        child._counts = [0] * len(child._counts)
+                        child._sum = 0.0
+                        child._count = 0
+                    else:
+                        child._value = 0.0
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._metrics)
@@ -470,9 +489,17 @@ def reset_registry() -> MetricsRegistry:
 
     Producers that captured child references keep writing to their
     old (now detached) children; live subsystems re-register on next
-    construction.
+    construction. Job teardown must use :func:`zero_registry` instead.
     """
     global _registry
     with _registry_lock:
         _registry = MetricsRegistry()
         return _registry
+
+
+def zero_registry() -> None:
+    """In-place reset for last-job teardown: every series drops to zero
+    but every registration — and every import-time child reference held
+    across the codebase — stays wired into the live registry."""
+    with _registry_lock:
+        _registry.zero()

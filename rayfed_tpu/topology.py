@@ -319,8 +319,12 @@ def plan_buffer(slots: Iterable[str]) -> TopologyPlan:
 # Job-level default (config: aggregation.topology / aggregation.group_size)
 # ---------------------------------------------------------------------------
 
-_default_lock = threading.Lock()  # fedlint: disable=global-mutable-singleton (default topology; reset_default() at shutdown)
-_default: Dict[str, object] = {"topology": "auto", "group_size": None}  # fedlint: disable=global-mutable-singleton (default topology; reset_default() at shutdown)
+from rayfed_tpu.tenancy.context import JobScoped
+
+_defaults: JobScoped = JobScoped(
+    "topology.default",
+    default_factory=lambda: {"topology": "auto", "group_size": None},
+)
 
 
 def set_default(topology: str = "auto",
@@ -334,15 +338,16 @@ def set_default(topology: str = "auto",
         )
     if group_size is not None and int(group_size) < 2:
         raise ValueError("aggregation.group_size must be >= 2")
-    with _default_lock:
-        _default["topology"] = topology
-        _default["group_size"] = None if group_size is None else int(group_size)
+    _defaults.set({
+        "topology": topology,
+        "group_size": None if group_size is None else int(group_size),
+    })
 
 
 def get_default() -> Tuple[str, Optional[int]]:
-    with _default_lock:
-        return _default["topology"], _default["group_size"]  # type: ignore
+    d = _defaults.get()
+    return d["topology"], d["group_size"]
 
 
 def reset_default() -> None:
-    set_default("auto", None)
+    _defaults.pop()

@@ -199,8 +199,8 @@ def test_ping_others_down_peer_keeps_cadence():
             calls.append(dest)
             return Future()  # in flight forever (peer never comes up)
 
-    old = barriers._sender_proxy
-    barriers._sender_proxy = _NeverResolvingSender()
+    old = barriers._sender_proxies.peek()
+    barriers._sender_proxies.set(_NeverResolvingSender())
     try:
         t0 = time.perf_counter()
         with pytest.raises(RuntimeError, match="Failed to wait"):
@@ -210,7 +210,10 @@ def test_ping_others_down_peer_keeps_cadence():
             )
         elapsed = time.perf_counter() - t0
     finally:
-        barriers._sender_proxy = old
+        if old is None:
+            barriers._sender_proxies.pop()
+        else:
+            barriers._sender_proxies.set(old)
     # Exactly one ping stays in flight for the down peer across all
     # cycles (the data lane retries inside it).
     assert calls == ["bob"], calls
@@ -241,23 +244,24 @@ def test_ping_others_mutual_and_grace():
         def ping_sources(self):
             return set(self._srcs), self._anon
 
-    old_s, old_r = barriers._sender_proxy, barriers._receiver_proxy
+    old_s = barriers._sender_proxies.peek()
+    old_r = barriers._receiver_proxies.peek()
     try:
-        barriers._sender_proxy = _OkSender()
+        barriers._sender_proxies.set(_OkSender())
         # Mutual: bob pinged us -> immediate pass, no grace burned.
-        barriers._receiver_proxy = _Recv(srcs={"bob"})
+        barriers._receiver_proxies.set(_Recv(srcs={"bob"}))
         assert barriers.ping_others(
             {"alice": "a:1", "bob": "b:1"}, "alice",
             max_retries=3, interval_s=0.02,
         )
         # Anonymous ping covers an unattributable peer (reference wire).
-        barriers._receiver_proxy = _Recv(anon=1)
+        barriers._receiver_proxies.set(_Recv(anon=1))
         assert barriers.ping_others(
             {"alice": "a:1", "bob": "b:1"}, "alice",
             max_retries=3, interval_s=0.02,
         )
         # Never pinged back: released after the grace cycles.
-        barriers._receiver_proxy = _Recv()
+        barriers._receiver_proxies.set(_Recv())
         assert barriers.ping_others(
             {"alice": "a:1", "bob": "b:1"}, "alice",
             max_retries=barriers._MUTUAL_GRACE_CYCLES + 3, interval_s=0.02,
@@ -272,7 +276,7 @@ def test_ping_others_mutual_and_grace():
             def ping_sources(self):
                 return None
 
-        barriers._receiver_proxy = _NoAttr()
+        barriers._receiver_proxies.set(_NoAttr())
         t0 = _time.perf_counter()
         assert barriers.ping_others(
             {"alice": "a:1", "bob": "b:1"}, "alice",
@@ -280,7 +284,12 @@ def test_ping_others_mutual_and_grace():
         )
         assert _time.perf_counter() - t0 < 1.0  # << grace (5 x 0.5s)
     finally:
-        barriers._sender_proxy, barriers._receiver_proxy = old_s, old_r
+        for slot, old in ((barriers._sender_proxies, old_s),
+                          (barriers._receiver_proxies, old_r)):
+            if old is None:
+                slot.pop()
+            else:
+                slot.set(old)
 
 
 def test_ping_sources_backend_capabilities():

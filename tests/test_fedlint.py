@@ -236,6 +236,7 @@ def test_schema_matches_config_dataclasses():
         "ServingConfig": "rayfed_tpu.config",
         "TcpCrossSiloMessageConfig": "rayfed_tpu.config",
         "TelemetryConfig": "rayfed_tpu.telemetry.config",
+        "TenancyConfig": "rayfed_tpu.tenancy.context",
     }
     assert set(modules) == set(schema.CONFIG_CLASS_FIELDS)
     for name, module in modules.items():

@@ -473,7 +473,7 @@ def test_job_checkpoint_cut_roundtrip(tmp_path):
         agg.offer("alice", trees["alice"], round_tag=0)
         agg.offer("bob", trees["bob"], round_tag=1)
         with ar._tags_lock:
-            ar._driver_round_tags["hacut"] = 7
+            ar._driver_round_tags.get()["hacut"] = 7
         path = fed.save_job_state(
             str(tmp_path), step=7, model=model, opt_state=opt_state
         )

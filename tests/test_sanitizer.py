@@ -296,17 +296,21 @@ def test_barriers_send_seam_calls_probe(sanitizer, monkeypatch):
         sanitize, "probe_send_seq",
         lambda dest, seq, epoch: seen.append((dest, seq, epoch)),
     )
-    monkeypatch.setattr(barriers, "_seq_epoch_fn", lambda: 7)
 
     class _Proxy:
         def send(self, *args, **kwargs):
             return True
 
-    monkeypatch.setattr(barriers, "_sender_proxy", _Proxy())
-    barriers.send("bob", b"x", 1, 5)
-    assert seen == [("bob", 5, 7)]
-    barriers.send("bob", b"x", 1, 6, is_error=True)
-    assert seen == [("bob", 5, 7)]  # error envelopes are exempt
+    barriers.set_seq_epoch_fn(lambda: 7)
+    barriers._sender_proxies.set(_Proxy())
+    try:
+        barriers.send("bob", b"x", 1, 5)
+        assert seen == [("bob", 5, 7)]
+        barriers.send("bob", b"x", 1, 6, is_error=True)
+        assert seen == [("bob", 5, 7)]  # error envelopes are exempt
+    finally:
+        barriers._sender_proxies.pop()
+        barriers.clear_seq_epoch_fn()
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,131 @@
+# Copyright 2026 The rayfed-tpu Authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+# See the License for the specific language governing permissions and
+# limitations under the License.
+
+"""Tenancy-plane regression gate (docs/multitenancy.md).
+
+Two gates, both LOUD (exit 1):
+
+1. **Byte-identical isolation — non-negotiable.** The sequential and
+   concurrent twin tests must pass: a job run beside (or after) another
+   job must produce results byte-identical to an isolated run, and
+   ``fed.shutdown`` must leave zero per-job residue in any JobScoped
+   slot. There is no knob to relax this gate.
+2. **Weighted-fair QoS.** bench.py's tenant stage (two jobs, one shared
+   listener, bulk backlog at weights 4:1 beside inline serving traffic)
+   must report ``tenant_fairness_ratio`` at or above the floor and
+   ``multitenant_victim_p99_ms`` at or below the budget — a scheduler
+   change that starves the light tenant, or a transport change that
+   lets bulk frames queue ahead of the inline class, turns the build
+   red here.
+
+Knobs:
+
+  FEDTPU_TENANT_FAIRNESS       default 0.25 — floor on the weight-
+                               normalized bulk byte ratio (1.0 is
+                               perfectly fair; 0.25 tolerates a 4x
+                               skew at the configured 1:4 split, i.e.
+                               the light tenant is merely not starved).
+  FEDTPU_TENANT_P99_MS         default 250 — victim inline p99 budget.
+  FEDTPU_TENANT_WALL_BUDGET_S  default 600 — hard cap on the whole
+                               check.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+#: the non-negotiable isolation gate: these tests ARE the contract.
+ISOLATION_TESTS = [
+    "tests/test_tenancy.py::test_sequential_jobs_byte_identical",
+    "tests/test_tenancy.py::test_concurrent_jobs_byte_identical_to_isolated",
+    "tests/test_tenancy.py::test_shutdown_clears_every_jobscoped_slot",
+    "tests/test_tenancy.py::test_two_jobs_share_one_listener_port",
+    "tests/test_multitenant_chaos.py::test_multitenant_isolation",
+]
+
+
+def main() -> int:
+    fairness_floor = float(os.environ.get("FEDTPU_TENANT_FAIRNESS", "0.25"))
+    p99_budget_ms = float(os.environ.get("FEDTPU_TENANT_P99_MS", "250"))
+    wall_budget_s = float(
+        os.environ.get("FEDTPU_TENANT_WALL_BUDGET_S", "600")
+    )
+    t0 = time.monotonic()
+
+    print("tenant gate 1/2: byte-identical isolation", flush=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", FEDTPU_SANITIZE="1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         *ISOLATION_TESTS],
+        cwd=_REPO_ROOT, env=env,
+    )
+    if proc.returncode != 0:
+        print(
+            "TENANT GATE FAILED: isolation tests failed — a job is no "
+            "longer byte-identical to its isolated run (or leaves "
+            "residue). This gate is non-negotiable.",
+            file=sys.stderr,
+        )
+        return 1
+
+    if time.monotonic() - t0 > wall_budget_s:
+        print(
+            f"TENANT GATE WALL-CLOCK BREACH: isolation tests alone ate "
+            f"the {wall_budget_s:.0f}s budget.",
+            file=sys.stderr,
+        )
+        return 1
+
+    print("tenant gate 2/2: weighted-fair QoS", flush=True)
+    import bench
+
+    res = bench._run_tenant_bench()
+    ratio = res.get("tenant_fairness_ratio")
+    p99 = res.get("multitenant_victim_p99_ms")
+    print(
+        f"tenant_fairness_ratio={ratio} (floor {fairness_floor}) "
+        f"multitenant_victim_p99_ms={p99} (budget {p99_budget_ms:.0f}) "
+        f"bulk_mb={res.get('tenant_bulk_mb')}",
+        flush=True,
+    )
+    if ratio is None or ratio < fairness_floor:
+        print(
+            f"TENANT GATE FAILED: fairness ratio {ratio} below the "
+            f"{fairness_floor} floor (FEDTPU_TENANT_FAIRNESS) — the "
+            f"light tenant is being starved of shared-lane bandwidth.",
+            file=sys.stderr,
+        )
+        return 1
+    if p99 is None or p99 > p99_budget_ms:
+        print(
+            f"TENANT GATE FAILED: victim inline p99 {p99}ms over the "
+            f"{p99_budget_ms:.0f}ms budget (FEDTPU_TENANT_P99_MS) — "
+            f"bulk neighbor traffic is queuing ahead of the inline "
+            f"class.",
+            file=sys.stderr,
+        )
+        return 1
+    print("tenant gate OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
