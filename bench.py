@@ -1293,9 +1293,13 @@ def _serve_bench_entry(result_path, clients, requests_per_client, reps):
             params=params[0],
         )
         try:
-            # One discarded request compiles the prefill + step programs
-            # so the timed window measures the scheduler, not XLA.
-            srv.submit_and_wait(list(range(1, 9)), max_new_tokens=2)
+            # Discarded requests compile the prefill + step programs for
+            # EVERY prompt bucket the clients will hit (plen 4..12 spans
+            # three buckets) so the timed window measures the scheduler,
+            # not XLA.
+            for plen in (8, 4, 12):
+                srv.submit_and_wait(list(range(1, plen + 1)), max_new_tokens=2)
+            warm = srv.stats()["completed"]
             latencies, tokens = [], [0]
             lock = threading.Lock()
 
@@ -1319,7 +1323,7 @@ def _serve_bench_entry(result_path, clients, requests_per_client, reps):
             def publisher():
                 for thr in (max(1, total // 3), max(2, 2 * total // 3)):
                     while True:
-                        done = srv.stats()["completed"] - 1  # - warmup
+                        done = srv.stats()["completed"] - warm
                         if done >= total:
                             return  # window drained before the swap slot
                         if done >= thr:
@@ -1353,8 +1357,122 @@ def _serve_bench_entry(result_path, clients, requests_per_client, reps):
         finally:
             srv.stop()
 
+    def stream_ttft_window(n_requests=8):
+        """Streaming clients: median ms from submit to FIRST streamed
+        token (the latency win streaming buys over waiting for the full
+        response)."""
+        srv = InferenceServer(
+            cfg,
+            ServingConfig(
+                max_slots=8, max_len=64, max_new_tokens=max_new,
+                max_pending=max(64, 2 * total),
+            ),
+            params=params[0],
+        )
+        try:
+            for plen in (8, 4, 12):  # compile every bucket (see above)
+                srv.submit_and_wait(list(range(1, plen + 1)), max_new_tokens=2)
+            ttfts = []
+            lock = threading.Lock()
+
+            def client(ci):
+                rng = np.random.default_rng(7000 + ci)
+                plen = int(rng.integers(4, 13))
+                prompt = [
+                    int(t)
+                    for t in rng.integers(1, cfg.vocab - 1, size=plen)
+                ]
+                t0 = time.perf_counter()
+                fut, stream = srv.submit_stream(
+                    prompt, max_new_tokens=max_new
+                )
+                for _ in stream:
+                    break  # first token only; the rest streams on
+                first = stream.first_token_s
+                fut.result(timeout=300)
+                with lock:
+                    ttfts.append((first - t0) * 1e3)
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(n_requests)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return float(np.percentile(ttfts, 50))
+        finally:
+            srv.stop()
+
+    def mixed_window(n_short=16, long_len=1024, short_new=16, long_new=8):
+        """Fragmentation regression: 16 short requests interleaved with
+        one 1024-token prompt. Paged KV admits the shorts while the long
+        prompt chunk-prefills under the token budget; the gateable
+        number is the short requests' p99."""
+        srv = InferenceServer(
+            cfg,
+            ServingConfig(
+                max_slots=8, max_len=long_len + long_new + 8,
+                max_new_tokens=max(short_new, long_new),
+                max_pending=2 * (n_short + 1),
+                prompt_buckets=[16, long_len],
+            ),
+            params=params[0],
+        )
+        try:
+            # Warm the short bucket AND the chunked-prefill program (a
+            # 64-token prompt exceeds prefill_chunk, compiling the chunk
+            # step the 1024-token prompt will reuse).
+            srv.submit_and_wait(list(range(1, 9)), max_new_tokens=2)
+            srv.submit_and_wait(list(range(1, 65)), max_new_tokens=2)
+            rng = np.random.default_rng(4242)
+            long_prompt = [
+                int(t)
+                for t in rng.integers(1, cfg.vocab - 1, size=long_len)
+            ]
+            lat = []
+            lock = threading.Lock()
+
+            def short_client(ci):
+                r = np.random.default_rng(5000 + ci)
+                prompt = [
+                    int(t)
+                    for t in r.integers(
+                        1, cfg.vocab - 1, size=int(r.integers(4, 13))
+                    )
+                ]
+                resp = srv.submit_and_wait(
+                    prompt, max_new_tokens=short_new
+                )
+                with lock:
+                    lat.append(resp["latency_ms"])
+
+            long_fut = srv.submit(
+                np.asarray(long_prompt, np.int32), max_new_tokens=long_new
+            )
+            threads = [
+                threading.Thread(target=short_client, args=(i,))
+                for i in range(n_short)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            long_resp = long_fut.result(timeout=300)
+            assert len(long_resp["tokens"]) == long_new
+            st = srv.stats()
+            return {
+                "p99_ms": float(np.percentile(lat, 99)),
+                "chunks": st["prefill_chunks"],
+            }
+        finally:
+            srv.stop()
+
     windows = [window("continuous", swap=True) for _ in range(reps)]
     naive = window("sequential", swap=False)
+    ttft_ms = stream_ttft_window()
+    mixed = mixed_window()
     tok = [w["tokens_s"] for w in windows]
     p99 = [w["p99_ms"] for w in windows]
     out = {
@@ -1373,6 +1491,9 @@ def _serve_bench_entry(result_path, clients, requests_per_client, reps):
         "serve_batching_speedup": round(
             statistics.median(tok) / naive["tokens_s"], 2
         ),
+        "serve_stream_ttft_ms": round(ttft_ms, 1),
+        "serve_mixed_p99_ms": round(mixed["p99_ms"], 1),
+        "serve_mixed_prefill_chunks": mixed["chunks"],
     }
     with open(result_path, "w") as f:
         json.dump(out, f)

@@ -595,6 +595,23 @@ class ServingConfig:
         prompt_buckets: prefill compiles once per bucket length; prompts
             are right-padded up to the next bucket (padding is causally
             invisible). None = powers of two up to ``max_len``.
+        kv_layout: "paged" (block-granular KV pool with on-demand block
+            grant — the serving-v2 data path) or "slab" (one contiguous
+            ``max_len+1`` row per slot, the PR 8 layout). Bitwise-identical
+            outputs on identical traffic; paged admits mixed-length
+            traffic without stranding whole rows.
+        kv_block_size: positions per KV block (paged layout only).
+        kv_blocks: physical blocks in the paged pool (one extra
+            sacrificial block is allocated internally). None = slab-
+            equivalent capacity: ``max_slots * ceil((max_len+1)/block)``.
+        prefill_chunk: prompts longer than this prefill in chunks merged
+            into the running decode iteration (chunked prefill) instead of
+            one monolithic forward that stalls the live batch.
+        prefill_token_budget: max prefill tokens processed per engine
+            iteration — the prefill:decode budget that bounds how long a
+            long admission can delay the next decode step.
+        stream_window: max coalesced token frames in flight per streamed
+            request (client streaming backpressure window).
     """
 
     max_slots: int = 8
@@ -606,12 +623,23 @@ class ServingConfig:
     prefix_reuse: bool = True
     mode: str = "continuous"
     prompt_buckets: Optional[List[int]] = None
+    kv_layout: str = "paged"
+    kv_block_size: int = 16
+    kv_blocks: Optional[int] = None
+    prefill_chunk: int = 32
+    prefill_token_budget: int = 64
+    stream_window: int = 4
 
     def __post_init__(self):
         if self.mode not in ("continuous", "sequential"):
             raise ValueError(
                 f"serving.mode must be 'continuous' or 'sequential', "
                 f"got {self.mode!r}"
+            )
+        if self.kv_layout not in ("paged", "slab"):
+            raise ValueError(
+                f"serving.kv_layout must be 'paged' or 'slab', "
+                f"got {self.kv_layout!r}"
             )
         if self.max_new_tokens < 1:
             raise ValueError("serving.max_new_tokens must be >= 1")
@@ -621,12 +649,34 @@ class ServingConfig:
                 f"(max_new_tokens={self.max_new_tokens} >= "
                 f"max_len={self.max_len})"
             )
+        if self.kv_block_size < 1:
+            raise ValueError("serving.kv_block_size must be >= 1")
+        if self.kv_blocks is not None and self.kv_blocks < 1:
+            raise ValueError("serving.kv_blocks must be >= 1")
+        if self.prefill_chunk < 1:
+            raise ValueError("serving.prefill_chunk must be >= 1")
+        if self.prefill_token_budget < self.prefill_chunk:
+            raise ValueError(
+                "serving.prefill_token_budget must be >= prefill_chunk "
+                f"({self.prefill_token_budget} < {self.prefill_chunk})"
+            )
+        if self.stream_window < 1:
+            raise ValueError("serving.stream_window must be >= 1")
 
     @classmethod
     def from_dict(cls, data: Optional[Dict[str, Any]]) -> "ServingConfig":
+        """STRICT build from ``config['serving']``: unknown keys raise
+        with the known-key list (a typo'd knob rejects ``fed.init``
+        instead of silently never taking effect)."""
         data = data or {}
         field_names = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in data.items() if k in field_names})
+        for key in data:
+            if key not in field_names:
+                raise ValueError(
+                    f"unknown serving config key {key!r}; known keys: "
+                    f"{sorted(field_names)}"
+                )
+        return cls(**data)
 
 
 # MembershipConfig lives with the elastic-membership subsystem

@@ -14,9 +14,12 @@
 
 """The config-key schema FED009 checks literal dicts against.
 
-``*Config.from_dict`` silently DROPS unknown keys (``config.py``'s
-reference-parity contract), so a typo'd knob never takes effect and
-never errors — the worst failure mode a linter can close. The tables
+Most ``*Config.from_dict`` methods silently DROP unknown keys
+(``config.py``'s reference-parity contract), so a typo'd knob never
+takes effect and never errors — the worst failure mode a linter can
+close. (``ServingConfig.from_dict`` is the exception: it raises on
+unknown keys at ``fed.init``; FED009 still catches the same typo before
+the job ever launches.) The tables
 here are a static mirror of the dataclasses in ``rayfed_tpu/config.py``
 (+ membership/privacy/serving): fedlint must import nothing heavier than
 the stdlib, so the mirror is hand-maintained and pinned by
@@ -57,8 +60,10 @@ RETRY_POLICY_FIELDS = frozenset({
 PARTY_MESH_FIELDS = frozenset({"axis_names", "device_ids", "mesh_shape"})
 
 SERVING_FIELDS = frozenset({
-    "eos_id", "max_len", "max_new_tokens", "max_pending", "max_slots",
-    "mode", "prefix_reuse", "prompt_buckets", "temperature",
+    "eos_id", "kv_block_size", "kv_blocks", "kv_layout", "max_len",
+    "max_new_tokens", "max_pending", "max_slots", "mode", "prefill_chunk",
+    "prefill_token_budget", "prefix_reuse", "prompt_buckets",
+    "stream_window", "temperature",
 })
 
 MEMBERSHIP_FIELDS = frozenset({

@@ -18,14 +18,19 @@ One party hosts the freshest aggregate and serves generate / beam /
 speculative-decode requests under concurrent load while training rounds
 keep landing new aggregates:
 
- - :mod:`rayfed_tpu.serving.server` — admission control + continuous
-   (iteration-level) batching over a slot-pooled KV cache;
- - :mod:`rayfed_tpu.serving.kv_pool` — the slot pool (allocate once,
-   recycle slots, prefix reuse for identical prompts);
- - :mod:`rayfed_tpu.serving.publish` — versioned atomic hot model swap;
+ - :mod:`rayfed_tpu.serving.server` — admission control (batched paged
+   prefill, chunked prefill with a per-step token budget) + continuous
+   (iteration-level) batching over the KV pool;
+ - :mod:`rayfed_tpu.serving.kv_pool` — the KV store, two layouts:
+   the contiguous slab and the block-granular paged pool (block tables,
+   on-demand grants, prefix reuse by table sharing);
+ - :mod:`rayfed_tpu.serving.publish` — versioned atomic hot model swap,
+   shm zero-copy snapshot adoption;
+ - :mod:`rayfed_tpu.serving.stream` — incremental token streaming over
+   the inline lane;
  - :mod:`rayfed_tpu.serving.client` — ``fed.serve()`` /
    ``fed.submit_request()``: requests ride the small-message inline lane,
-   model swaps ride the bulk/striped lane.
+   model swaps ride the bulk/striped lane (and replicate to standbys).
 """
 
 from rayfed_tpu.serving.client import (  # noqa: F401
@@ -33,11 +38,17 @@ from rayfed_tpu.serving.client import (  # noqa: F401
     serve,
     submit_request,
 )
+from rayfed_tpu.serving.kv_pool import KVPool, PagedKVPool  # noqa: F401
 from rayfed_tpu.serving.publish import ModelBank  # noqa: F401
 from rayfed_tpu.serving.server import (  # noqa: F401
     InferenceServer,
     ServerOverloadedError,
     ServerStoppedError,
+)
+from rayfed_tpu.serving.stream import (  # noqa: F401
+    LocalTokenStream,
+    StreamConsumerError,
+    TokenStream,
 )
 
 __all__ = [
@@ -45,7 +56,12 @@ __all__ = [
     "submit_request",
     "ServeHandle",
     "InferenceServer",
+    "KVPool",
+    "PagedKVPool",
     "ModelBank",
+    "LocalTokenStream",
+    "TokenStream",
+    "StreamConsumerError",
     "ServerOverloadedError",
     "ServerStoppedError",
 ]

@@ -57,10 +57,79 @@ def _serve_submit(name: str, prompt, opts: Dict[str, Any]):
 
 
 @fed.remote
+def _serve_submit_stream(
+    name: str, prompt, opts: Dict[str, Any], stream_id: str, stream_to: str
+):
+    from rayfed_tpu._private.global_context import get_global_context as _gc
+    from rayfed_tpu.serving import stream as stream_mod
+    from rayfed_tpu.serving.server import get_server
+
+    srv = get_server(name)
+    me = _gc().get_current_party()
+    if stream_to == me:
+        sink = stream_mod.register_local_stream(stream_id)
+    else:
+        sink = stream_mod.RemoteStreamSink(
+            stream_to, stream_id, window=srv.scfg.stream_window
+        )
+    fut = srv.submit(prompt, stream=sink, **opts)
+    return fut.result()
+
+
+@fed.remote
 def _serve_publish(name: str, params, draft_params=None):
     from rayfed_tpu.serving.server import get_server
 
     return get_server(name).publish(params, draft_params=draft_params)
+
+
+@fed.remote
+def _serve_replicate(name: str, params, version, draft_params=None):
+    """Standby-side publish mirror: adopt the primary's new version into
+    the replica bank AT the primary's version number (restore_state
+    keeps the numbering monotonic across a later promotion)."""
+    from rayfed_tpu.serving.server import get_standby
+
+    spec = get_standby(name)
+    if spec is None:
+        return 0
+    extras = {}
+    if draft_params is not None:
+        extras["draft_params"] = draft_params
+    return spec["bank"].restore_state(
+        {"version": int(version), "params": params, "extras": extras}
+    )
+
+
+@fed.remote
+def _serve_promote(name: str):
+    """Turn this party's standby replica into the live engine for
+    ``name``: build an InferenceServer around the replicated bank state
+    and register it. Queued/looping clients resubmit to the new host."""
+    from rayfed_tpu.config import ServingConfig as _SC
+    from rayfed_tpu.serving.server import (
+        InferenceServer,
+        pop_standby,
+        register_server,
+    )
+
+    spec = pop_standby(name)
+    if spec is None:
+        raise RuntimeError(
+            f"no standby replica named {name!r} on this party — it was "
+            "not listed in fed.serve(standby=...)"
+        )
+    server = InferenceServer(
+        spec["model_cfg"],
+        _SC.from_dict(spec["config"]),
+        params=None,
+        draft_cfg=spec.get("draft_cfg"),
+        cache_dtype=spec.get("cache_dtype"),
+        name=name,
+    )
+    version = server.bank.restore_state(spec["bank"].export_state())
+    register_server(server)
+    return version
 
 
 @fed.remote
@@ -88,9 +157,11 @@ class ServeHandle:
     DAG node, so every driver must reach it).
     """
 
-    def __init__(self, party: str, name: str = "default"):
+    def __init__(self, party: str, name: str = "default", standby=()):
         self.party = party
         self.name = name
+        self.standby = tuple(standby)
+        self._stream_n = 0  # deterministic: same sequence on every driver
 
     def submit(
         self,
@@ -101,10 +172,18 @@ class ServeHandle:
         seed: int = 0,
         mode: str = "generate",
         n_beams: int = 4,
-    ) -> FedObject:
+        stream_to: Optional[str] = None,
+    ):
         """Enqueue one request at the serving party; returns a FedObject
         of the response dict. Issue many submits before getting any — the
-        engine batches whatever is in flight at each token boundary."""
+        engine batches whatever is in flight at each token boundary.
+
+        With ``stream_to=<party>`` the return is ``(FedObject,
+        TokenStream)`` and tokens additionally stream to that party
+        incrementally as the engine samples them; only the ``stream_to``
+        party's driver may iterate the stream (every driver must still
+        pass the SAME ``stream_to`` — the stream id burns like a seq id).
+        """
         opts: Dict[str, Any] = {"seed": int(seed), "mode": mode}
         if max_new_tokens is not None:
             opts["max_new_tokens"] = int(max_new_tokens)
@@ -114,21 +193,49 @@ class ServeHandle:
             opts["n_beams"] = int(n_beams)
         prompt = [int(t) for t in prompt]
         _m_client_submits.labels(party=self.party).inc()
-        return (
-            _serve_submit.party(self.party)
+        if stream_to is None:
+            return (
+                _serve_submit.party(self.party)
+                .options(eager=False)
+                .remote(self.name, prompt, opts)
+            )
+        from rayfed_tpu.serving.stream import TokenStream
+
+        stream_id = f"{self.name}:{self._stream_n}"
+        self._stream_n += 1
+        resp = (
+            _serve_submit_stream.party(self.party)
             .options(eager=False)
-            .remote(self.name, prompt, opts)
+            .remote(self.name, prompt, opts, stream_id, stream_to)
         )
+        return resp, TokenStream(self.party, stream_id)
 
     def publish(self, params, draft_params=None) -> FedObject:
         """Install ``params`` (a value or a FedObject — e.g. the result
         of ``fed_aggregate``) as the next served version; returns a
         FedObject of the version number. When the aggregate lives at
         another party this is exactly an owner-push of the param tree
-        over the bulk lane."""
-        return _serve_publish.party(self.party).remote(
+        over the bulk lane. Standby parties (``fed.serve(standby=...)``)
+        receive the same version into their replica banks."""
+        version = _serve_publish.party(self.party).remote(
             self.name, params, draft_params
         )
+        for sb in self.standby:
+            _serve_replicate.party(sb).remote(
+                self.name, params, version, draft_params
+            )
+        return version
+
+    def promote(self, new_host: str) -> FedObject:
+        """Fail the serving role over to ``new_host`` (which must have
+        been a ``standby=`` party): its replica bank becomes the live
+        engine at the primary's last replicated version. Every surviving
+        driver must call this identically; the handle re-addresses
+        itself, so queued submits can simply be re-issued."""
+        version = _serve_promote.party(new_host).remote(self.name)
+        self.party = new_host
+        self.standby = tuple(s for s in self.standby if s != new_host)
+        return version
 
     def stats(self) -> FedObject:
         return _serve_stats.party(self.party).remote(self.name)
@@ -147,6 +254,7 @@ def serve(
     draft_cfg=None,
     cache_dtype=None,
     name: str = "default",
+    standby=(),
 ) -> ServeHandle:
     """Start (on ``party``) and address (everywhere) a serving engine.
 
@@ -154,6 +262,13 @@ def serve(
     only on the hosting party. ``config`` overrides the job-level
     ``config['serving']`` dict from ``fed.init``. ``params`` seeds
     version 1; otherwise the first :meth:`ServeHandle.publish` does.
+
+    ``standby`` parties hold a passive replica: every
+    :meth:`ServeHandle.publish` mirrors the new version into their
+    replica banks, and :meth:`ServeHandle.promote` turns one into the
+    live engine after the host dies — at the last replicated version,
+    with zero requests aborted by the swap itself (clients re-issue
+    whatever the dead host never answered).
 
     Burns no seq ids — the handle is pure addressing; the engine build is
     party-local (``get_server`` resolves it inside remote tasks).
@@ -163,15 +278,16 @@ def serve(
         raise RuntimeError(
             "rayfed_tpu is not initialized; call fed.init() first."
         )
-    if ctx.get_current_party() == party:
+    me = ctx.get_current_party()
+    merged = dict(get_default_serving_config() or {})
+    merged.update(config or {})
+    if me == party:
         if model_cfg is None:
             raise ValueError(
                 "fed.serve on the hosting party needs model_cfg"
             )
         from rayfed_tpu.serving.server import InferenceServer, register_server
 
-        merged = dict(get_default_serving_config() or {})
-        merged.update(config or {})
         server = InferenceServer(
             model_cfg,
             ServingConfig.from_dict(merged),
@@ -181,7 +297,26 @@ def serve(
             name=name,
         )
         register_server(server)
-    return ServeHandle(party, name)
+    elif me in standby:
+        if model_cfg is None:
+            raise ValueError(
+                "fed.serve on a standby party needs model_cfg"
+            )
+        from rayfed_tpu.serving.publish import ModelBank
+        from rayfed_tpu.serving.server import register_standby
+
+        ServingConfig.from_dict(merged)  # fail here, not at promotion
+        bank = ModelBank()
+        if params is not None:
+            bank.publish(params)
+        register_standby(name, {
+            "model_cfg": model_cfg,
+            "config": merged,
+            "draft_cfg": draft_cfg,
+            "cache_dtype": cache_dtype,
+            "bank": bank,
+        })
+    return ServeHandle(party, name, standby=standby)
 
 
 def submit_request(handle: ServeHandle, prompt, **opts) -> FedObject:

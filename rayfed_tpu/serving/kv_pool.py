@@ -12,14 +12,40 @@
 # See the License for the specific language governing permissions and
 # limitations under the License.
 
-"""Slot-pooled K/V cache for the serving plane.
+"""Slot-pooled and block-paged K/V caches for the serving plane.
 
-vLLM-style pooling adapted to the stacked-cache layout of
+Two layouts share one engine contract:
+
+:class:`KVPool` (``serving.kv_layout = "slab"``) — vLLM-style slot
+pooling adapted to the stacked-cache layout of
 :mod:`rayfed_tpu.models.decode`: ONE (L, max_slots, max_len+1, H, Dh)
 cache pair is allocated at server start and every request borrows one
 batch row (a *slot*) for its lifetime — no per-request allocation, no
 per-request compile (the batched decode step is shaped by the pool, not
 by the set of live requests).
+
+:class:`PagedKVPool` (``"paged"``, the default) — PagedAttention-shaped
+block granularity (Kwon et al. 2023) over the same stacked layout: the
+physical cache is (L, 1 + num_blocks, block_size, H, Dh) and each slot
+holds an int32 *block table* mapping logical block i of its sequence to
+a physical block. Blocks are granted on demand at token boundaries and
+returned to a free list at release — a short generation pins
+ceil(len/block_size) blocks, not a whole ``max_len`` row, so
+mixed-length traffic stops stranding memory. Block recycling needs no
+zeroing (same sacrificial-position argument as the slab layout, see
+below), prefix reuse is a block-table copy plus one boundary-block
+clone instead of a full row copy, and every grant/free is charged to
+the tenant ledger so ``tenancy.kv_block_quota`` means actual resident
+blocks.
+
+Bitwise compatibility: the paged decode step gathers each row's block
+chain into a contiguous (L, R, max_len+1, H, Dh) scratch slab, runs the
+LITERAL SAME jitted step program as the slab layout (identical shapes →
+identical executable → identical bits), then scatters each row's single
+written position back through its block table. On real accelerators the
+gather stands in for a fused paged-attention kernel; here it is the
+correctness-first CPU reference, which is exactly what makes
+paged-vs-slab parity testable bit-for-bit.
 
 Sacrificial position: the cache is one position longer than ``max_len``.
 A batched decode step always runs every pool row; rows that are free, or
@@ -49,6 +75,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from rayfed_tpu.models import decode
 from rayfed_tpu.models import transformer as tfm
@@ -156,3 +183,363 @@ class KVPool:
             jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32),
         )
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _copy_block(pk, pv, src, dst):
+    """Copy physical block ``src`` over block ``dst`` (prefix-reuse
+    boundary clone)."""
+    kb = jax.lax.dynamic_slice_in_dim(pk, src, 1, axis=1)
+    vb = jax.lax.dynamic_slice_in_dim(pv, src, 1, axis=1)
+    pk = jax.lax.dynamic_update_slice_in_dim(pk, kb, dst, axis=1)
+    pv = jax.lax.dynamic_update_slice_in_dim(pv, vb, dst, axis=1)
+    return pk, pv
+
+
+class PagedKVPool:
+    """Block-granular K/V pool: ``max_slots`` logical rows over
+    ``num_blocks`` shared physical blocks (+ the sacrificial block 0).
+
+    Block tables live on the host as plain int32 numpy (they change a
+    few entries per iteration; shipping them into jitted programs as
+    arguments keeps every program fixed-shape). Physical block 0 is the
+    junk target: ungranted table entries point at it, junk decode rows
+    scatter into it, and no real query ever attends a position that
+    resolves to it — so recycled blocks are never zeroed, exactly the
+    slab layout's sacrificial-position argument at block granularity.
+
+    Tenant accounting: every fresh block grant charges one ``kv_blocks``
+    unit against the constructing job's :class:`TenantResourceLedger`
+    and every physical free releases it, so the quota tracks resident
+    memory rather than a static slot count. Prefix-shared blocks are
+    charged once (they are one physical block).
+    """
+
+    def __init__(
+        self,
+        cfg: tfm.TransformerConfig,
+        max_slots: int,
+        max_len: int,
+        dtype=None,
+        *,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+    ):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        if max_len < 2:
+            raise ValueError("max_len must be >= 2")
+        if block_size < 1:
+            raise ValueError("kv_block_size must be >= 1")
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_len = max_len
+        self.junk_pos = max_len
+        self.block_size = int(block_size)
+        # Logical blocks per full-length row; the gather slab is
+        # (max_len + 1) long so the same step program as the slab layout
+        # (sacrificial position included) compiles once and is shared.
+        self.row_len = max_len + 1
+        self.blocks_per_row = -(-self.row_len // self.block_size)
+        self.num_blocks = (
+            int(num_blocks)
+            if num_blocks
+            else max_slots * self.blocks_per_row
+        )
+        if self.num_blocks < 1:
+            raise ValueError("kv_blocks must be >= 1")
+        cache = decode.init_cache(
+            cfg, 1 + self.num_blocks, self.block_size, dtype
+        )
+        self._k = cache["k"]
+        self._v = cache["v"]
+        self._lock = threading.Lock()
+        self._free_slots: List[int] = list(range(max_slots))
+        # pop() hands out low block ids first.
+        self._free_blocks: List[int] = list(range(self.num_blocks, 0, -1))
+        # Physical block refcounts (prefix sharing); index 0 unused.
+        self._refcnt = [0] * (1 + self.num_blocks)
+        self._tables = np.zeros(
+            (max_slots, self.blocks_per_row), np.int32
+        )
+        # Granted logical blocks per slot (always a contiguous prefix of
+        # the table).
+        self._granted = [0] * max_slots
+        self._prefix: Dict[int, Tuple[int, bytes]] = {}
+        from rayfed_tpu.tenancy.context import current_job
+
+        self._job = current_job()
+        self._build_fns()
+
+    # -- jitted data movement (engine thread only) -----------------------
+
+    def _build_fns(self) -> None:
+        NB = self.blocks_per_row
+        bs = self.block_size
+        T = self.row_len
+        R = self.max_slots
+
+        def gather(pk, pv, tables):
+            # tables: (R, NB) int32. Result rows are bit-identical to the
+            # slab layout's cache rows for every granted position; junk
+            # entries resolve to block 0 garbage at masked positions.
+            L = pk.shape[0]
+            H, Dh = pk.shape[-2:]
+            k = pk[:, tables].reshape(L, R, NB * bs, H, Dh)[:, :, :T]
+            v = pv[:, tables].reshape(L, R, NB * bs, H, Dh)[:, :, :T]
+            return k, v
+
+        self._gather_fn = jax.jit(gather)
+
+        def gather_row(pk, pv, table):
+            # table: (NB,) int32 -> one (L, T, H, Dh) row.
+            L = pk.shape[0]
+            H, Dh = pk.shape[-2:]
+            k = pk[:, table].reshape(L, NB * bs, H, Dh)[:, :T]
+            v = pv[:, table].reshape(L, NB * bs, H, Dh)[:, :T]
+            return k, v
+
+        self._gather_row_fn = jax.jit(gather_row)
+
+        def scatter_step(pk, pv, k_slab, v_slab, positions, wblocks, woffs):
+            # Extract each row's single written position from the step
+            # output and write it through the block table. Junk rows
+            # target (block 0, off 0); duplicate junk writes are garbage
+            # into the sacrificial block, never read unmasked.
+            rows = jnp.arange(R)
+            kn = k_slab[:, rows, positions]
+            vn = v_slab[:, rows, positions]
+            pk = pk.at[:, wblocks, woffs].set(kn)
+            pv = pv.at[:, wblocks, woffs].set(vn)
+            return pk, pv
+
+        # Only the pool arrays are donatable (the step/prefill slabs
+        # differ in shape from the outputs, so they could never alias).
+        self._scatter_step_fn = jax.jit(
+            scatter_step, donate_argnums=(0, 1)
+        )
+
+        pad = NB * bs - T
+
+        def scatter_rows(pk, pv, k_slab, v_slab, tables):
+            # Write whole (R, T)-shaped prefill output back through the
+            # scatter tables. Rows that must not land (junk vmap lanes,
+            # already-live neighbours) carry an all-zero table.
+            L = pk.shape[0]
+            H, Dh = pk.shape[-2:]
+            if pad:
+                z = jnp.zeros((L, R, pad, H, Dh), k_slab.dtype)
+                k_slab = jnp.concatenate([k_slab, z], axis=2)
+                v_slab = jnp.concatenate([v_slab, z], axis=2)
+            kp = k_slab.reshape(L, R, NB, bs, H, Dh)
+            vp = v_slab.reshape(L, R, NB, bs, H, Dh)
+            pk = pk.at[:, tables].set(kp)
+            pv = pv.at[:, tables].set(vp)
+            return pk, pv
+
+        self._scatter_rows_fn = jax.jit(
+            scatter_rows, donate_argnums=(0, 1)
+        )
+
+        def scatter_row(pk, pv, k_row, v_row, table):
+            L = pk.shape[0]
+            H, Dh = pk.shape[-2:]
+            if pad:
+                z = jnp.zeros((L, pad, H, Dh), k_row.dtype)
+                k_row = jnp.concatenate([k_row, z], axis=1)
+                v_row = jnp.concatenate([v_row, z], axis=1)
+            kp = k_row.reshape(L, NB, bs, H, Dh)
+            vp = v_row.reshape(L, NB, bs, H, Dh)
+            pk = pk.at[:, table].set(kp)
+            pv = pv.at[:, table].set(vp)
+            return pk, pv
+
+        self._scatter_row_fn = jax.jit(
+            scatter_row, donate_argnums=(0, 1)
+        )
+
+    def gather(self, tables: np.ndarray):
+        """Assemble (L, R, max_len+1, H, Dh) scratch rows for one step."""
+        return self._gather_fn(self._k, self._v, jnp.asarray(tables))
+
+    def gather_slot(self, slot: int):
+        """One slot's contiguous row (chunked-prefill input)."""
+        with self._lock:
+            table = self._tables[slot].copy()
+        return self._gather_row_fn(self._k, self._v, jnp.asarray(table))
+
+    def scatter_step(self, k_slab, v_slab, positions, wblocks, woffs) -> None:
+        self._k, self._v = self._scatter_step_fn(
+            self._k, self._v, k_slab, v_slab,
+            jnp.asarray(positions), jnp.asarray(wblocks),
+            jnp.asarray(woffs),
+        )
+
+    def scatter_rows(self, k_slab, v_slab, tables: np.ndarray) -> None:
+        self._k, self._v = self._scatter_rows_fn(
+            self._k, self._v, k_slab, v_slab, jnp.asarray(tables)
+        )
+
+    def scatter_slot(self, slot: int, k_row, v_row) -> None:
+        with self._lock:
+            table = self._tables[slot].copy()
+        self._k, self._v = self._scatter_row_fn(
+            self._k, self._v, k_row, v_row, jnp.asarray(table)
+        )
+
+    @property
+    def nbytes(self) -> int:
+        return int(self._k.nbytes) + int(self._v.nbytes)
+
+    # -- slot + block lifecycle ------------------------------------------
+
+    def acquire(self) -> Optional[int]:
+        with self._lock:
+            if not self._free_slots:
+                return None
+            return self._free_slots.pop()
+
+    def release(self, slot: int) -> None:
+        freed = 0
+        with self._lock:
+            if slot in self._free_slots:
+                raise ValueError(f"slot {slot} double-released")
+            for i in range(self._granted[slot]):
+                blk = int(self._tables[slot, i])
+                self._refcnt[blk] -= 1
+                if self._refcnt[blk] == 0:
+                    self._free_blocks.append(blk)
+                    freed += 1
+            self._tables[slot] = 0
+            self._granted[slot] = 0
+            self._prefix.pop(slot, None)
+            self._free_slots.append(slot)
+        if freed:
+            self._ledger_release(freed)
+
+    def ensure_blocks(self, slot: int, pos: int) -> str:
+        """Grant blocks so position ``pos`` of ``slot`` is resident.
+
+        Returns ``"ok"``, ``"full"`` (free list empty) or ``"quota"``
+        (tenant ledger refused). Grants are all-or-nothing per call:
+        a partial grant is kept (it covers earlier positions and will
+        satisfy a retry), never rolled back.
+        """
+        needed = pos // self.block_size + 1
+        while True:
+            with self._lock:
+                if self._granted[slot] >= needed:
+                    return "ok"
+                if not self._free_blocks:
+                    return "full"
+            # Charge outside the pool lock (the ledger has its own).
+            if not self._ledger_charge(1):
+                return "quota"
+            with self._lock:
+                if not self._free_blocks:
+                    charged_back = True
+                else:
+                    charged_back = False
+                    blk = self._free_blocks.pop()
+                    self._refcnt[blk] = 1
+                    self._tables[slot, self._granted[slot]] = blk
+                    self._granted[slot] += 1
+            if charged_back:
+                self._ledger_release(1)
+                return "full"
+
+    def _ledger_charge(self, n: int) -> bool:
+        from rayfed_tpu.tenancy.qos import TenantQuotaExceeded, get_ledger
+
+        try:
+            get_ledger().charge(self._job, "kv_blocks", n)
+            return True
+        except TenantQuotaExceeded:
+            return False
+
+    def _ledger_release(self, n: int) -> None:
+        from rayfed_tpu.tenancy.qos import get_ledger
+
+        get_ledger().release(self._job, "kv_blocks", n)
+
+    def table(self, slot: int) -> np.ndarray:
+        with self._lock:
+            return self._tables[slot].copy()
+
+    def write_target(self, slot: int, pos: int) -> Tuple[int, int]:
+        """(physical block, offset) for writing position ``pos``."""
+        with self._lock:
+            return (
+                int(self._tables[slot, pos // self.block_size]),
+                pos % self.block_size,
+            )
+
+    def granted(self, slot: int) -> int:
+        with self._lock:
+            return self._granted[slot]
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free_slots)
+
+    @property
+    def blocks_free(self) -> int:
+        with self._lock:
+            return len(self._free_blocks)
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free_blocks)
+
+    # -- prefix reuse (block-chain sharing) ------------------------------
+
+    def note_prefix(self, slot: int, version: int, prompt_key: bytes) -> None:
+        with self._lock:
+            self._prefix[slot] = (version, prompt_key)
+
+    def lookup_prefix(self, version: int, prompt_key: bytes) -> Optional[int]:
+        with self._lock:
+            for slot, key in self._prefix.items():
+                if key == (version, prompt_key):
+                    return slot
+        return None
+
+    def adopt_prefix(self, donor: int, dst: int, plen: int) -> str:
+        """Share the donor's fully-prompt blocks with ``dst`` (refcount
+        bump, no data movement) and clone the boundary block when the
+        prompt ends mid-block — the donor decodes into its own boundary
+        copy, so sharing it would mix sequences. Returns "ok", "full" or
+        "quota"; on failure the shares are rolled back and the caller
+        falls through to a normal prefill.
+        """
+        bs = self.block_size
+        full = plen // bs
+        with self._lock:
+            for i in range(full):
+                blk = int(self._tables[donor, i])
+                self._refcnt[blk] += 1
+                self._tables[dst, i] = blk
+            self._granted[dst] = full
+        if plen % bs == 0:
+            return "ok"
+        status = self.ensure_blocks(dst, plen - 1)
+        if status != "ok":
+            with self._lock:
+                for i in range(full):
+                    blk = int(self._tables[dst, i])
+                    self._refcnt[blk] -= 1
+                self._tables[dst, :full] = 0
+                self._granted[dst] = 0
+            return status
+        with self._lock:
+            src_blk = int(self._tables[donor, full])
+            dst_blk = int(self._tables[dst, full])
+        self._k, self._v = _copy_block(
+            self._k,
+            self._v,
+            jnp.asarray(src_blk, jnp.int32),
+            jnp.asarray(dst_blk, jnp.int32),
+        )
+        return "ok"

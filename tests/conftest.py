@@ -71,6 +71,7 @@ import pytest  # noqa: E402
 # (VERDICT r2 weak #7). New tests default to fast until measured.
 _SLOW_TESTS = {
     "test_churn_chaos_replace_dead_party",
+    "test_modelbank_crash_promote_serves_all_requests",
     "test_join_leave_lifecycle",
     "test_coordinator_failover_mid_round",
     "test_async_root_killed_rebuild_publishes",

@@ -503,7 +503,7 @@ def test_executor_eager_false_goes_to_pool():
 # Two-party e2e: fed.serve on alice, submits from both drivers, a hot
 # swap whose params arrive as an owner-push over the wire from bob.
 
-from tests.utils import FAST_COMM_CONFIG, run_parties  # noqa: E402
+from tests.utils import FAST_COMM_CONFIG, get_addresses, run_parties  # noqa: E402
 
 import rayfed_tpu as fed  # noqa: E402
 
@@ -549,3 +549,390 @@ def run_serve_two_party(party, addresses):
 
 def test_serve_two_party_e2e():
     run_parties(run_serve_two_party, ["alice", "bob"])
+
+
+# ---------------------------------------------------------------------------
+# Serving plane v2: paged KV layout. The bitwise contract — a request's
+# output depends only on (version, prompt, seed), never on the KV layout
+# or on what shares its batch — is what lets the paged pool ship as the
+# default without invalidating any recorded generation.
+
+
+def test_paged_matches_slab_bitwise_mixed_lengths():
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(t) for t in rng.integers(1, 255, size=n)]
+        for n in (3, 9, 14, 5, 12, 7)
+    ]
+    outs = {}
+    for layout in ("slab", "paged"):
+        srv = _server(kv_layout=layout, temperature=0.8)
+        try:
+            futs = [
+                srv.submit(p, max_new_tokens=8, seed=i)
+                for i, p in enumerate(prompts)
+            ]
+            outs[layout] = [f.result(timeout=120)["tokens"] for f in futs]
+        finally:
+            srv.stop()
+    assert outs["paged"] == outs["slab"]
+
+
+def test_chunked_prefill_matches_reference():
+    srv = _server(max_len=48, prefill_chunk=8, prefill_token_budget=16)
+    try:
+        rng = np.random.default_rng(3)
+        prompt = [int(t) for t in rng.integers(1, 255, size=21)]
+        resp = srv.submit_and_wait(prompt, max_new_tokens=6)
+        assert resp["tokens"] == _reference(PARAMS_A, prompt, 6)
+        # 21 tokens at chunk 8: ragged 5 first, then 8 + 8.
+        assert srv.stats()["prefill_chunks"] >= 3
+    finally:
+        srv.stop()
+
+
+def test_preemption_under_block_pressure_matches_unconstrained():
+    rng = np.random.default_rng(11)
+    prompts = [
+        [int(t) for t in rng.integers(1, 255, size=8)] for _ in range(6)
+    ]
+
+    def run(**kw):
+        srv = _server(max_slots=4, kv_block_size=4, **kw)
+        try:
+            futs = [
+                srv.submit(p, max_new_tokens=8, seed=i)
+                for i, p in enumerate(prompts)
+            ]
+            out = [f.result(timeout=120)["tokens"] for f in futs]
+            return out, srv.stats()
+        finally:
+            srv.stop()
+
+    base, _ = run()
+    # The 4 rows decode in lockstep and each grows to 3 blocks by
+    # position 8 — exactly the pool's 12 grantable blocks. At position
+    # 12 all four need a 4th block with zero free and none finished: a
+    # true deadlock only preemption can break. The preempt-and-replay
+    # must be invisible in the output.
+    tight, st = run(kv_blocks=12)
+    assert tight == base
+    assert st["preempted"] >= 1
+    assert st["completed"] == len(prompts)
+    assert st["kv_blocks_in_use"] == 0
+
+
+def test_mixed_length_fragmentation_shorts_overtake_long_prompt():
+    """16 short requests race one 1024-token prompt: chunked prefill
+    must interleave the long prompt's chunks with live decode so the
+    shorts finish first instead of queueing behind a monolithic
+    prefill."""
+    long_len = 1024
+    srv = _server(
+        max_slots=8, max_len=long_len + 16, max_new_tokens=16,
+        max_pending=64, prompt_buckets=[16, long_len],
+    )
+    try:
+        rng = np.random.default_rng(42)
+        long_prompt = np.asarray(
+            rng.integers(1, 255, size=long_len), np.int32
+        )
+        done_at = {}
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def short_client(ci):
+            r = np.random.default_rng(100 + ci)
+            p = [int(t) for t in r.integers(1, 255, size=int(r.integers(4, 13)))]
+            srv.submit_and_wait(p, max_new_tokens=8)
+            with lock:
+                done_at[ci] = time.perf_counter() - t0
+
+        long_fut = srv.submit(long_prompt, max_new_tokens=8)
+        threads = [
+            threading.Thread(target=short_client, args=(i,))
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        long_resp = long_fut.result(timeout=300)
+        long_done = time.perf_counter() - t0
+        assert len(long_resp["tokens"]) == 8
+        st = srv.stats()
+        assert st["prefill_chunks"] >= long_len // 32
+        # The long prompt needs >= 32 budgeted chunk steps; every short
+        # (8 tokens of decode) must land well inside that window.
+        assert sum(1 for dt in done_at.values() if dt < long_done) >= 8
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Token streaming (in-process; the wire path is covered by the e2e below)
+
+
+def test_stream_matches_complete_response():
+    srv = _server()
+    try:
+        prompt = list(range(5, 15))
+        fut, stream = srv.submit_stream(prompt, max_new_tokens=8)
+        streamed = list(stream)
+        resp = fut.result(timeout=120)
+        assert streamed == resp["tokens"] == _reference(PARAMS_A, prompt, 8)
+        assert stream.first_token_s is not None
+        assert srv.stats()["streamed_tokens"] >= len(streamed)
+    finally:
+        srv.stop()
+
+
+def test_slow_stream_consumer_never_blocks_engine():
+    srv = _server()
+    try:
+        prompt = list(range(5, 15))
+        fut, stream = srv.submit_stream(prompt, max_new_tokens=8)
+        # NOBODY consumes the stream; the engine must still finish this
+        # request, free its KV blocks, and keep serving others.
+        resp = fut.result(timeout=120)
+        others = [
+            srv.submit(list(range(2, 10)), max_new_tokens=6, seed=i)
+            for i in range(4)
+        ]
+        for f in others:
+            f.result(timeout=120)
+        assert srv.stats()["kv_blocks_in_use"] == 0
+        # The unread tokens are still there once the consumer catches up.
+        assert stream.tokens() == resp["tokens"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Per-block-grant tenancy accounting
+
+
+def test_paged_kv_quota_trip_fails_request_and_cleans_ledger():
+    from rayfed_tpu.tenancy import context as tenancy
+    from rayfed_tpu.tenancy import qos as tenancy_qos
+    from rayfed_tpu.tenancy.context import TenancyConfig, TenantQuotaExceeded
+
+    ctx = tenancy.create_context(
+        "quota_paged", "alice", tenancy=TenancyConfig(kv_block_quota=2)
+    )
+    try:
+        with tenancy.use_context(ctx):
+            srv = _server(kv_block_size=4)
+            try:
+                # 8-token prompt + 8 new needs 4 blocks; the quota of 2
+                # covers the prefill grant but the first decode-step
+                # grant can NEVER succeed (no other tenant request holds
+                # blocks to release), so the engine fails fast instead
+                # of stalling.
+                fut = srv.submit(list(range(1, 9)), max_new_tokens=8)
+                with pytest.raises(TenantQuotaExceeded) as exc:
+                    fut.result(timeout=120)
+                assert exc.value.resource == "kv_blocks"
+                # A request that fits under quota still serves.
+                resp = srv.submit_and_wait([1, 2, 3], max_new_tokens=2)
+                assert len(resp["tokens"]) == 2
+            finally:
+                srv.stop()
+            assert tenancy_qos.get_ledger().in_use(
+                "quota_paged", "kv_blocks"
+            ) == 0
+    finally:
+        tenancy.remove_context("quota_paged")
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy publish + ModelBank replication
+
+
+def test_publish_adopts_shm_backed_leaves_zero_copy():
+    fw = pytest.importorskip("rayfed_tpu._fastwire")
+    ring = fw.shm_ring_create("t_serving_zcopy", 1 << 20)
+    try:
+        arr = np.arange(1024, dtype=np.float32)
+        payload = arr.tobytes()
+        off = fw.shm_ring_push(ring, [payload])
+        assert off is not None
+        view = np.frombuffer(
+            fw.shm_ring_adopt(ring, off, len(payload)), dtype=np.float32
+        )
+        bank = ModelBank()
+        bank.publish({"w": view, "b": np.ones(4, np.float32)})
+        # The shm-backed leaf is adopted by reference, the plain one
+        # copied: exactly one zero-copy adoption.
+        assert bank.zerocopy_adopted() == 1
+        _, snap = bank.acquire()
+        np.testing.assert_array_equal(np.asarray(snap["w"]), arr)
+    finally:
+        fw.shm_ring_close(ring)
+
+
+def test_bank_export_restore_preserves_version_and_monotonicity():
+    bank = ModelBank()
+    bank.publish(PARAMS_A)
+    bank.publish(PARAMS_B)
+    replica = ModelBank()
+    replica.restore_state(bank.export_state())
+    ver, params = replica.acquire()
+    assert ver == 2
+    np.testing.assert_array_equal(
+        np.asarray(params["embed"]), np.asarray(PARAMS_B["embed"])
+    )
+    replica.release(ver)
+    # Version numbers keep counting from the restored point: a promoted
+    # standby can never reissue a version id the fleet has seen.
+    assert replica.publish(PARAMS_A) == 3
+
+
+# ---------------------------------------------------------------------------
+# Two-party e2e: token streaming over the wire — bob consumes alice's
+# engine output incrementally and the stream equals the full response.
+
+
+def run_serve_stream_two_party(party, addresses):
+    fed.init(addresses=addresses, party=party, config=CONFIG)
+    handle = fed.serve("alice", CFG, params=PARAMS_A)
+    prompt = list(range(5, 13))
+    resp, stream = handle.submit(prompt, max_new_tokens=6, stream_to="bob")
+    streamed = None
+    if party == "bob":
+        streamed = []
+        for tok in stream:
+            streamed.append(tok)
+            assert stream.first_token_s is not None  # set AT first token
+    tokens = fed.get(resp)["tokens"]
+    assert tokens == _reference(PARAMS_A, prompt, 6)
+    if party == "bob":
+        assert streamed == tokens
+    assert fed.get(handle.shutdown()) is True
+    fed.shutdown()
+
+
+def test_serve_streaming_two_party_e2e():
+    run_parties(run_serve_stream_two_party, ["alice", "bob"])
+
+
+# ---------------------------------------------------------------------------
+# Three-party chaos: the ModelBank holder crashes mid-window. The
+# standby's replica (fed by publish-time replication) is promoted and
+# every request the crash orphaned is re-served — zero aborted.
+
+BC_PARTIES = ["alice", "bob", "carol"]
+BC_PROMPT = list(range(5, 13))
+BC_N = 8
+
+
+def _bc_comm(extra=None):
+    # Few retries + a short send deadline so sends to the dead primary
+    # fail fast, but a LONG recv window: survivors legitimately skew by
+    # tens of seconds while timing out their orphaned gets, and the
+    # promote result must survive that skew.
+    cfg = {
+        "retry_policy": {
+            "max_attempts": 2,
+            "initial_backoff_ms": 50,
+            "max_backoff_ms": 100,
+        },
+        "timeout_in_ms": 2000,
+        "recv_timeout_in_ms": 60000,
+        "send_deadline_in_ms": 4000,
+    }
+    cfg.update(extra or {})
+    return cfg
+
+
+def _run_bank_crash_party(party, addresses, workdir):
+    config = {
+        "cross_silo_comm": _bc_comm(
+            {"exit_on_sending_failure": True} if party == "alice" else None
+        ),
+        "serving": {"max_slots": 4, "max_len": 48, "max_new_tokens": 8},
+    }
+    if party == "alice":
+        # Replicating v2 to carol is alice's first data send; the crash
+        # then lands while response pushes are still streaming out, so
+        # some of the window is orphaned mid-flight.
+        config["resilience"] = {"fault_schedule": {
+            "seed": 7,
+            "rules": [{"fault": "crash", "src": "alice", "after": 6}],
+        }}
+    fed.init(
+        addresses=addresses, party=party, config=config,
+        sending_failure_handler=(
+            (lambda e: os._exit(0)) if party == "alice" else None
+        ),
+    )
+    try:
+        handle = fed.serve(
+            "alice", CFG, params=PARAMS_A, standby=("carol",)
+        )
+        handle.publish(PARAMS_B)  # v2, replicated to carol's bank
+        futs = [
+            handle.submit(BC_PROMPT, max_new_tokens=6, seed=i)
+            for i in range(BC_N)
+        ]
+        got = [fed.get(f, timeout=3.0, on_missing="default") for f in futs]
+    except BaseException:
+        if party == "alice":
+            os._exit(0)  # expected death throes past the crash point
+        raise
+    if party == "alice":
+        # The injected crash fires on a transport thread as the response
+        # pushes drain; wait for it rather than racing it.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        os._exit(1)  # crash never fired: fail the test
+    missing = [i for i, r in enumerate(got) if r is fed.MISSING]
+    assert missing, "crash landed after the window drained"
+    promoted = fed.get(handle.promote("carol"), timeout=60.0)
+    assert promoted == 2  # the replica held the crashed primary's version
+    # Resubmit the WHOLE window: each driver must trace the identical
+    # program, and the per-party missing sets differ (the crash orphans
+    # different pushes per consumer) — per-party resubmission would
+    # diverge the seq space and deadlock the survivors. Originals that
+    # did land are preferred; the redo fills the holes.
+    redo = [
+        handle.submit(BC_PROMPT, max_new_tokens=6, seed=i)
+        for i in range(BC_N)
+    ]
+    redo_got = [
+        fed.get(f, timeout=60.0, on_missing="default") for f in redo
+    ]
+    refs = {
+        1: _reference(PARAMS_A, BC_PROMPT, 6),
+        2: _reference(PARAMS_B, BC_PROMPT, 6),
+    }
+    aborted, versions = 0, {}
+    for i, r in enumerate(got):
+        if r is fed.MISSING:
+            r = redo_got[i]
+        if r is fed.MISSING:
+            aborted += 1
+            continue
+        assert r["tokens"] == refs[r["version"]]
+        versions[str(i)] = r["version"]
+    assert aborted == 0
+    with open(os.path.join(workdir, f"{party}.json"), "w") as f:
+        json.dump(
+            {"missing": missing, "promoted": promoted,
+             "versions": versions},
+            f, sort_keys=True,
+        )
+    fed.shutdown()
+
+
+def test_modelbank_crash_promote_serves_all_requests(tmp_path):
+    run_parties(
+        _run_bank_crash_party, BC_PARTIES, timeout=200,
+        extra_args=(str(tmp_path),), addresses=get_addresses(BC_PARTIES),
+    )
+    for p in ("bob", "carol"):
+        doc = json.loads((tmp_path / f"{p}.json").read_text())
+        assert doc["promoted"] == 2
+        assert doc["missing"]  # the crash DID orphan part of the window
+        assert len(doc["versions"]) == BC_N  # ...and every request served

@@ -94,6 +94,7 @@ class Model:
             sends = _series_sum(m, "fed_transport_send_ops_total")
             inline = _series_sum(m, "fed_transport_inline_sends_total")
             tokens = _series_sum(m, "fed_serving_tokens_total")
+            streamed = _series_sum(m, "fed_serving_streamed_tokens_total")
             rows.append({
                 "party": party,
                 "stale": p.get("stale", False),
@@ -107,11 +108,17 @@ class Model:
                 "depth": _series_sum(m, "fed_async_buffer_depth"),
                 "version": _series_sum(m, "fed_async_version"),
                 "tok_rate": _rate(tokens, prev.get("tokens", 0.0), dt),
+                "stream_rate": _rate(
+                    streamed, prev.get("streamed", 0.0), dt
+                ),
                 "pending": _series_sum(m, "fed_serving_pending"),
                 "active": _series_sum(m, "fed_serving_active"),
+                "kv_used": _series_sum(m, "fed_serving_kv_blocks_in_use"),
+                "kv_free": _series_sum(m, "fed_serving_kv_blocks_free"),
             })
             self._prev[party] = {
                 "sends": sends, "inline": inline, "tokens": tokens,
+                "streamed": streamed,
             }
         self._prev_t = now
         return header, rows
@@ -120,7 +127,8 @@ class Model:
 _COLS = (
     ("PARTY", 10), ("STATE", 7), ("AGE", 6), ("EPOCH", 5),
     ("SEND/S", 8), ("INL/S", 8), ("LANES", 5), ("BUF", 4),
-    ("VER", 4), ("TOK/S", 8), ("PEND", 5), ("ACT", 4),
+    ("VER", 4), ("TOK/S", 8), ("STRM/S", 8), ("PEND", 5), ("ACT", 4),
+    ("KVUSE", 6), ("KVFREE", 6),
 )
 
 
@@ -141,7 +149,9 @@ def render_lines(header: dict, rows: list) -> list:
             f"{r['send_rate']:.1f}", f"{r['inline_rate']:.1f}",
             f"{int(r['lanes'])}", f"{int(r['depth'])}",
             f"{int(r['version'])}", f"{r['tok_rate']:.1f}",
+            f"{r['stream_rate']:.1f}",
             f"{int(r['pending'])}", f"{int(r['active'])}",
+            f"{int(r['kv_used'])}", f"{int(r['kv_free'])}",
         )
         lines.append(
             "  ".join(f"{c:<{w}}" for c, (_, w) in zip(cells, _COLS))

@@ -34,12 +34,27 @@ host noise does not flake them — tighten on dedicated hardware):
                                 ``serve_p99_ms``. A request stalled by a
                                 hot swap (the bug the pinned-version
                                 design makes impossible) blows past it.
-  FEDTPU_SERVE_BUDGET_SPEEDUP   default 1.5 — floor on
+  FEDTPU_SERVE_BUDGET_SPEEDUP   default 3.0 — floor on
                                 ``serve_batching_speedup`` (continuous
                                 vs sequential admission on the SAME
-                                engine; measured ~2.0x). Broken
-                                continuous batching degenerates to
-                                ~1.0x, cleanly below the floor.
+                                engine; the paged layout's one-dispatch
+                                batched admission prefill measures well
+                                above it). Broken continuous batching
+                                degenerates to ~1.0x, cleanly below the
+                                floor.
+  FEDTPU_SERVE_BUDGET_TTFT_MS   default 2500.0 — ceiling on the median
+                                ``serve_stream_ttft_ms`` (submit to
+                                FIRST streamed token under concurrent
+                                load). A streaming path that buffers the
+                                whole generation before the first frame
+                                lands near the full-response latency,
+                                far above it.
+  FEDTPU_SERVE_BUDGET_MIXED_P99_MS default 8000.0 — ceiling on
+                                ``serve_mixed_p99_ms``: p99 of 16 short
+                                requests racing one 1024-token prompt.
+                                Without chunked prefill the long prompt
+                                monopolizes the engine for its whole
+                                forward and the shorts blow the ceiling.
   FEDTPU_BENCH_SERVE_CLIENTS / _REQS / _REPS — forwarded to the bench
                                 stage (defaults 8 / 4 / 3).
 
@@ -66,7 +81,13 @@ def main() -> int:
     )
     p99_ceiling = float(os.environ.get("FEDTPU_SERVE_BUDGET_P99_MS", "5000.0"))
     speedup_floor = float(
-        os.environ.get("FEDTPU_SERVE_BUDGET_SPEEDUP", "1.5")
+        os.environ.get("FEDTPU_SERVE_BUDGET_SPEEDUP", "3.0")
+    )
+    ttft_ceiling = float(
+        os.environ.get("FEDTPU_SERVE_BUDGET_TTFT_MS", "2500.0")
+    )
+    mixed_p99_ceiling = float(
+        os.environ.get("FEDTPU_SERVE_BUDGET_MIXED_P99_MS", "8000.0")
     )
 
     res = bench._run_serve_bench()
@@ -107,6 +128,37 @@ def main() -> int:
             f"Continuous batching has degenerated — prefill-then-merge "
             f"at token boundaries and early-exit of finished sequences "
             f"are the usual suspects."
+        )
+
+    ttft = res.get("serve_stream_ttft_ms")
+    if ttft is None or not (0.0 < float(ttft) < float("inf")):
+        failures.append(
+            f"serve_stream_ttft_ms={ttft!r}: the streaming client did "
+            "not produce a sane time-to-first-token — the stream path "
+            "is broken or the bench stage dropped the key."
+        )
+    elif float(ttft) > ttft_ceiling:
+        failures.append(
+            f"SERVING REGRESSION: serve_stream_ttft_ms={ttft} exceeds "
+            f"the {ttft_ceiling} ms ceiling. Streaming must deliver the "
+            f"first token as it is sampled, not after the generation "
+            f"completes — check _emit_token and the sink window."
+        )
+    mixed = res.get("serve_mixed_p99_ms")
+    if mixed is None:
+        failures.append(
+            "serve_mixed_p99_ms missing: the mixed-length window did "
+            "not run — bench._serve_bench_entry dropped the stage."
+        )
+    elif float(mixed) > mixed_p99_ceiling:
+        failures.append(
+            f"SERVING REGRESSION: serve_mixed_p99_ms={mixed} exceeds "
+            f"the {mixed_p99_ceiling} ms ceiling: short requests are "
+            f"being starved behind a 1024-token prompt. Chunked prefill "
+            f"(serving.prefill_chunk / prefill_token_budget) must merge "
+            f"long-prompt chunks into the live decode batch; "
+            f"serve_mixed_prefill_chunks="
+            f"{res.get('serve_mixed_prefill_chunks')}."
         )
 
     if failures:
